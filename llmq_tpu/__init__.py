@@ -1,0 +1,30 @@
+"""llmq_tpu — a TPU-native LLM serving framework.
+
+A ground-up rebuild of the capabilities of ZhangLearning/llm-message-queue
+(a Go microservice message queue for LLM serving) as a TPU-first framework:
+
+- **Control / queue plane** (``core``, ``queueing``, ``preprocessor``,
+  ``loadbalancer``, ``scheduling``, ``conversation``, ``api``): priority
+  message queues, SLA-aware scheduling, load balancing and conversation
+  state — re-designed in Python with a C++ native core for the hot queue
+  path (the reference has no native code at all; see SURVEY.md §2).
+- **Execution plane** (``models``, ``ops``, ``parallel``, ``executor``):
+  the part the reference only stubs behind external HTTP endpoints
+  (reference cmd/queue-manager/main.go:139-153 simulates LLM latency with
+  sleeps) — here a real JAX/XLA continuous-batching inference engine with
+  paged KV cache, Pallas kernels and pjit/shard_map tensor parallelism.
+
+Reference citations in docstrings use ``path:line`` into /root/reference.
+"""
+
+__version__ = "0.1.0"
+
+from llmq_tpu.core.types import (  # noqa: F401
+    Conversation,
+    ConversationState,
+    Message,
+    MessageStatus,
+    Priority,
+    QueueStats,
+)
+from llmq_tpu.core.config import Config, load_config, default_config  # noqa: F401

@@ -1,0 +1,29 @@
+"""Foundation layer (L1): data model, config, clock, errors.
+
+Mirrors the role of the reference's ``pkg/models`` + ``pkg/config``
+(reference pkg/models/message.go, pkg/config/config.go) with additions the
+reference lacks: typed errors, injectable clocks for deterministic tests,
+and TPU-topology config.
+"""
+
+from llmq_tpu.core.types import (  # noqa: F401
+    Conversation,
+    ConversationState,
+    Message,
+    MessageStatus,
+    Priority,
+    QueueStats,
+)
+from llmq_tpu.core.config import Config, load_config, default_config  # noqa: F401
+from llmq_tpu.core.clock import Clock, SystemClock, FakeClock  # noqa: F401
+from llmq_tpu.core.errors import (  # noqa: F401
+    LLMQError,
+    QueueNotFoundError,
+    QueueFullError,
+    QueueEmptyError,
+    MessageNotFoundError,
+    ConversationNotFoundError,
+    NoResourceError,
+    NoEndpointError,
+    AllocationNotFoundError,
+)

@@ -1,0 +1,95 @@
+"""Injectable clocks.
+
+The reference calls ``time.Now()``/``time.Sleep`` directly, forcing its
+tests to really sleep (e.g. tests/priorityqueue_test.go relies on
+``time.Sleep`` for delayed-queue assertions). Every time-dependent
+component here takes a ``Clock`` so tests run instantly with ``FakeClock``
+(SURVEY.md §4 calls this out as required new test infrastructure).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, List, Optional, Protocol, Tuple
+
+
+class Clock(Protocol):
+    def now(self) -> float: ...
+    def sleep(self, seconds: float) -> None: ...
+    def wait_on(self, cond: threading.Condition, timeout: Optional[float]) -> bool: ...
+
+
+class SystemClock:
+    """Real wall-clock."""
+
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+    def wait_on(self, cond: threading.Condition, timeout: Optional[float]) -> bool:
+        """Wait on a condition (caller holds the lock). Returns True if notified."""
+        return cond.wait(timeout=timeout)
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+
+class FakeClock:
+    """Deterministic manual clock for tests.
+
+    ``advance`` moves time forward and wakes any ``wait_on`` sleepers whose
+    deadline has passed, letting timer loops (delayed queue, TTL cleanup,
+    health checks) be driven without real sleeping.
+    """
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._now = start
+        self._lock = threading.Lock()
+        self._waiters: List[Tuple[float, threading.Condition]] = []
+        self._callbacks: List[Tuple[float, Callable[[], None]]] = []
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def monotonic(self) -> float:
+        return self.now()
+
+    def sleep(self, seconds: float) -> None:
+        # In tests a FakeClock sleep is a no-op yield; loops should use
+        # wait_on/conditions instead of bare sleeps.
+        return None
+
+    def wait_on(self, cond: threading.Condition, timeout: Optional[float]) -> bool:
+        if timeout is None:
+            return cond.wait(timeout=0.05)
+        with self._lock:
+            deadline = self._now + timeout
+            heapq.heappush(self._waiters, (deadline, id(cond), cond))  # type: ignore[arg-type]
+        # Block on the real condition briefly; advance() will notify.
+        return cond.wait(timeout=0.05)
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._now += seconds
+            due = [w for w in self._waiters if w[0] <= self._now]
+            self._waiters = [w for w in self._waiters if w[0] > self._now]
+            cbs = [c for t, c in self._callbacks if t <= self._now]
+            self._callbacks = [(t, c) for t, c in self._callbacks if t > self._now]
+        for _, _, cond in due:  # type: ignore[misc]
+            with cond:
+                cond.notify_all()
+        for cb in cbs:
+            cb()
+
+    def call_at(self, when: float, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._callbacks.append((when, cb))
+
+
+SYSTEM_CLOCK = SystemClock()

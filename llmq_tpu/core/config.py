@@ -1,0 +1,341 @@
+"""Typed configuration tree + loader.
+
+Capability parity with reference ``pkg/config/config.go``:
+
+- Typed config tree Server/Database/Queue/Scheduler/LoadBalancer/Logging/
+  Metrics (config.go:9-104), extended with the TPU execution-plane sections
+  the reference lacks (``model``, ``executor``, ``tpu``).
+- ``load_config`` = YAML file + environment-variable override
+  (config.go:106-125 uses Viper AutomaticEnv; here ``LLMQ_A_B_C=x``
+  overrides ``a.b.c``).
+- ``default_config`` carries the reference's canonical defaults: the four
+  queue tiers realtime 1s/100 · high 5s/200 · normal 30s/500 · low 5m/1000
+  (config.go:151-156), worker batch=10 / interval=100ms / concurrent=50
+  (config.go:169-173), retry backoff 1s→60s ×2.0 max 3 (config.go:174-179).
+
+Unlike the reference — whose canonical configs/config.yaml names strategies
+that don't exist in code and silently falls back (SURVEY.md §5 "Config") —
+unknown strategy names here raise at load time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from llmq_tpu.core.types import Priority
+
+VALID_LB_STRATEGIES = ("round_robin", "least_connections", "weighted_random", "adaptive_load")
+VALID_SCHEDULER_STRATEGIES = ("static", "dynamic", "adaptive", "hybrid")
+
+
+@dataclass
+class ServerConfig:
+    """Reference config.go:19-25."""
+    host: str = "0.0.0.0"
+    port: int = 8080
+    read_timeout: float = 30.0
+    write_timeout: float = 30.0
+
+
+@dataclass
+class PersistenceConfig:
+    """Durable conversation/message store.
+
+    Replaces the reference's Postgres+Redis pair (config.go:27-48) with a
+    pluggable backend: "memory" | "sqlite" | "redis" (redis gated on the
+    client lib being importable).
+    """
+    backend: str = "memory"
+    sqlite_path: str = "llmq_state.db"
+    redis_url: str = "redis://localhost:6379/0"
+    key_prefix: str = "llmq:"
+    cache_ttl: float = 24 * 3600.0  # statemanager/manager.go:229-241 (24h)
+
+
+@dataclass
+class QueueLevelConfig:
+    """One priority tier (reference config.go:57-62)."""
+    priority: int = int(Priority.NORMAL)
+    max_wait_time: float = 30.0
+    max_concurrent: int = 500
+
+    @property
+    def name(self) -> str:
+        return Priority(self.priority).tier_name
+
+
+@dataclass
+class WorkerConfig:
+    """Reference config.go:64-69; defaults from :169-173."""
+    count: int = 4
+    max_batch_size: int = 10
+    process_interval: float = 0.1
+    max_concurrent: int = 50
+
+
+@dataclass
+class RetryConfig:
+    """Reference config.go:71-77; defaults from :174-179."""
+    max_retries: int = 3
+    initial_backoff: float = 1.0
+    max_backoff: float = 60.0
+    backoff_multiplier: float = 2.0
+    strategy: str = "exponential"  # "exponential" | "fixed"
+
+
+@dataclass
+class QueueConfig:
+    """Reference config.go:50-55."""
+    max_queue_size: int = 10000
+    levels: List[QueueLevelConfig] = field(default_factory=lambda: default_queue_levels())
+    worker: WorkerConfig = field(default_factory=WorkerConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    enable_metrics: bool = True
+    # New: forward exhausted retries to the dead-letter queue (the
+    # reference leaves this unwired; SURVEY.md #7 "Not wired").
+    dead_letter_enabled: bool = True
+    dead_letter_max_size: int = 1000
+    stale_message_age: float = 3600.0  # cleanupStaleMessages stub (queue_manager.go:549-553), real here
+
+
+@dataclass
+class SchedulerConfig:
+    """Reference config.go:79-86."""
+    strategy: str = "dynamic"
+    monitor_interval: float = 10.0
+    scale_up_threshold: int = 100
+    scale_down_threshold: int = 10
+    min_endpoints: int = 1
+    max_endpoints: int = 10
+    cooldown: float = 60.0
+
+    def __post_init__(self) -> None:
+        if self.strategy not in VALID_SCHEDULER_STRATEGIES:
+            raise ValueError(
+                f"unknown scheduler strategy {self.strategy!r}; valid: {VALID_SCHEDULER_STRATEGIES}")
+
+
+@dataclass
+class ResourceSchedulerConfig:
+    """TPU-generalised resource scheduler (reference resource_scheduler.go:49-66)."""
+    allocation_timeout: float = 300.0
+    heartbeat_timeout: float = 30.0
+    pending_process_interval: float = 1.0
+    monitor_interval: float = 5.0
+    scale_up_load: float = 0.8
+    scale_down_load: float = 0.2
+    scale_cooldown: float = 120.0
+
+
+@dataclass
+class LoadBalancerConfig:
+    """Reference config.go:88-93."""
+    strategy: str = "round_robin"
+    health_check_interval: float = 30.0
+    max_retries: int = 3
+    session_affinity: bool = True
+    session_ttl: float = 1800.0
+
+    def __post_init__(self) -> None:
+        if self.strategy not in VALID_LB_STRATEGIES:
+            raise ValueError(
+                f"unknown load balancer strategy {self.strategy!r}; valid: {VALID_LB_STRATEGIES}")
+
+
+@dataclass
+class ConversationConfig:
+    """Unified conversation service (reference spreads this over three
+    managers; cmd/server/main.go:72-80 carries these defaults)."""
+    max_conversations: int = 1000
+    max_context_length: int = 4096
+    max_conversations_per_user: int = 100
+    ttl: float = 7 * 24 * 3600.0
+    max_idle_time: float = 1800.0
+    cleanup_interval: float = 300.0
+    persist: bool = True
+
+
+@dataclass
+class LoggingConfig:
+    """Reference config.go:95-99."""
+    level: str = "info"
+    format: str = "json"
+    output: str = "stdout"
+
+
+@dataclass
+class MetricsConfig:
+    """Reference config.go:100-104. Unlike the reference (which never
+    mounts promhttp — SURVEY.md §5), the API server really serves this."""
+    enabled: bool = True
+    port: int = 9090
+    path: str = "/metrics"
+
+
+@dataclass
+class ModelConfig:
+    """Execution-plane model selection (new scope; BASELINE configs #2/#5)."""
+    name: str = "llama3-tiny"          # llama3-tiny | llama3-8b | llama3-70b
+    checkpoint_path: str = ""           # orbax checkpoint dir; empty → random init
+    dtype: str = "bfloat16"
+    max_seq_len: int = 2048
+    vocab_size: int = 0                 # 0 → model default
+
+
+@dataclass
+class ExecutorConfig:
+    """Continuous-batching engine knobs (new scope)."""
+    backend: str = "echo"               # echo | jax
+    max_batch_size: int = 8             # decode slots
+    prefill_buckets: List[int] = field(default_factory=lambda: [128, 512, 2048])
+    kv_pages: int = 512
+    page_size: int = 16                 # tokens per KV page
+    max_decode_steps: int = 256
+    preemption: bool = True
+    kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
+
+
+@dataclass
+class TPUConfig:
+    """Mesh/topology declaration (new scope; BASELINE config #5)."""
+    mesh_shape: Dict[str, int] = field(default_factory=dict)  # e.g. {"dp": 1, "tp": 8}
+    platform: str = ""                  # "" → let JAX pick; "cpu" for tests
+
+
+@dataclass
+class Config:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
+    queue: QueueConfig = field(default_factory=QueueConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    resource_scheduler: ResourceSchedulerConfig = field(default_factory=ResourceSchedulerConfig)
+    loadbalancer: LoadBalancerConfig = field(default_factory=LoadBalancerConfig)
+    conversation: ConversationConfig = field(default_factory=ConversationConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    executor: ExecutorConfig = field(default_factory=ExecutorConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    def level_for(self, priority: Priority) -> QueueLevelConfig:
+        for lvl in self.queue.levels:
+            if lvl.priority == int(priority):
+                return lvl
+        return QueueLevelConfig(priority=int(priority))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def default_queue_levels() -> List[QueueLevelConfig]:
+    """The canonical 4 tiers (reference config.go:151-156)."""
+    return [
+        QueueLevelConfig(priority=int(Priority.REALTIME), max_wait_time=1.0, max_concurrent=100),
+        QueueLevelConfig(priority=int(Priority.HIGH), max_wait_time=5.0, max_concurrent=200),
+        QueueLevelConfig(priority=int(Priority.NORMAL), max_wait_time=30.0, max_concurrent=500),
+        QueueLevelConfig(priority=int(Priority.LOW), max_wait_time=300.0, max_concurrent=1000),
+    ]
+
+
+def default_config() -> Config:
+    """Reference GetDefaultConfig (config.go:127-203)."""
+    return Config()
+
+
+def _merge(obj: Any, data: Dict[str, Any], path: str = "") -> Any:
+    """Recursively apply a dict onto a dataclass tree."""
+    if not dataclasses.is_dataclass(obj):
+        return data
+    fields = {f.name: f for f in dataclasses.fields(obj)}
+    for key, value in data.items():
+        k = key.replace("-", "_")
+        if k not in fields:
+            raise ValueError(f"unknown config key: {path + key}")
+        current = getattr(obj, k)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            _merge(current, value, path + key + ".")
+        elif k == "levels" and isinstance(value, list):
+            obj.levels = [  # type: ignore[attr-defined]
+                _merge(QueueLevelConfig(), lv, path + "levels.") for lv in value
+            ]
+        else:
+            setattr(obj, k, value)
+    # Re-validate (dataclass __post_init__ does not rerun on setattr).
+    post = getattr(obj, "__post_init__", None)
+    if post is not None:
+        post()
+    return obj
+
+
+def _apply_env(cfg: Config, environ: Optional[Dict[str, str]] = None) -> None:
+    """``LLMQ_SERVER_PORT=9000`` overrides ``server.port`` (Viper
+    AutomaticEnv analogue, config.go:113)."""
+    env = os.environ if environ is None else environ
+    for key, raw in env.items():
+        if not key.startswith("LLMQ_"):
+            continue
+        parts = [p.lower() for p in key[len("LLMQ_"):].split("_")]
+        # Greedy walk: match the longest joined field names.
+        obj: Any = cfg
+        i = 0
+        ok = True
+        while i < len(parts) and ok:
+            if not dataclasses.is_dataclass(obj):
+                ok = False
+                break
+            names = {f.name for f in dataclasses.fields(obj)}
+            for j in range(len(parts), i, -1):
+                cand = "_".join(parts[i:j])
+                if cand in names:
+                    if j == len(parts):
+                        cur = getattr(obj, cand)
+                        setattr(obj, cand, _coerce(raw, cur))
+                        i = j
+                    else:
+                        obj = getattr(obj, cand)
+                        i = j
+                    break
+            else:
+                ok = False
+        # Unknown env keys are ignored (they may belong to other tools).
+
+
+def _coerce(raw: str, current: Any) -> Any:
+    if isinstance(current, bool):
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int) and not isinstance(current, bool):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, list):
+        return yaml.safe_load(raw)
+    if isinstance(current, dict):
+        return yaml.safe_load(raw)
+    return raw
+
+
+def load_config(path: Optional[str] = None, env: bool = True) -> Config:
+    """YAML + env override, mirroring LoadConfig (config.go:106-125).
+
+    Search order when ``path`` is None: ``./config.yaml``,
+    ``./configs/config.yaml`` (reference searches {configPath, ., ./configs}).
+    """
+    cfg = default_config()
+    candidates = [path] if path else ["config.yaml", os.path.join("configs", "config.yaml")]
+    for cand in candidates:
+        if cand and os.path.exists(cand):
+            with open(cand, "r") as f:
+                data = yaml.safe_load(f) or {}
+            _merge(cfg, data)
+            break
+    else:
+        if path:
+            raise FileNotFoundError(f"config file not found: {path}")
+    if env:
+        _apply_env(cfg)
+    return cfg

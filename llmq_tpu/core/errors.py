@@ -1,0 +1,78 @@
+"""Typed errors for the whole framework.
+
+The reference defines only three queue errors
+(internal/priorityqueue/queue.go:213-217) and signals everything else with
+``fmt.Errorf`` strings; here every subsystem failure has a type so callers
+and the REST layer can map them to status codes without string matching.
+"""
+
+from __future__ import annotations
+
+
+class LLMQError(Exception):
+    """Base class for all framework errors."""
+
+
+# --- queue plane (parity: queue.go:213-217) ---------------------------------
+
+class QueueNotFoundError(LLMQError, KeyError):
+    def __init__(self, name: str):
+        super().__init__(f"queue not found: {name}")
+        self.queue_name = name
+
+
+class QueueFullError(LLMQError):
+    def __init__(self, name: str, capacity: int):
+        super().__init__(f"queue full: {name} (capacity {capacity})")
+        self.queue_name = name
+        self.capacity = capacity
+
+
+class QueueEmptyError(LLMQError):
+    def __init__(self, name: str):
+        super().__init__(f"queue empty: {name}")
+        self.queue_name = name
+
+
+class MessageNotFoundError(LLMQError, KeyError):
+    def __init__(self, message_id: str):
+        super().__init__(f"message not found: {message_id}")
+        self.message_id = message_id
+
+
+# --- conversation service ---------------------------------------------------
+
+class ConversationNotFoundError(LLMQError, KeyError):
+    def __init__(self, conversation_id: str):
+        super().__init__(f"conversation not found: {conversation_id}")
+        self.conversation_id = conversation_id
+
+
+# --- resource scheduler / load balancer -------------------------------------
+
+class NoResourceError(LLMQError):
+    """No resource can satisfy the request (cf. resource_scheduler.go:213)."""
+
+
+class NoEndpointError(LLMQError):
+    """No healthy endpoint available (cf. load_balancer.go:258-261)."""
+
+
+class AllocationNotFoundError(LLMQError, KeyError):
+    def __init__(self, allocation_id: str):
+        super().__init__(f"allocation not found: {allocation_id}")
+        self.allocation_id = allocation_id
+
+
+# --- execution plane --------------------------------------------------------
+
+class ExecutorError(LLMQError):
+    """Inference engine failure (new scope; no reference counterpart)."""
+
+
+class KVCacheFullError(ExecutorError):
+    """Paged KV cache pool exhausted; admission must wait or evict."""
+
+
+class ModelNotLoadedError(ExecutorError):
+    pass

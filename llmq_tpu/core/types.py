@@ -1,0 +1,238 @@
+"""Core data model: messages, conversations, priorities, queue statistics.
+
+Capability parity with reference ``pkg/models/message.go``:
+
+- ``Priority`` 4-level tiers (message.go:15-22): 1=realtime, 2=high,
+  3=normal, 4=low — lower number is more urgent.
+- ``MessageStatus`` lifecycle (message.go:39-47).
+- ``ConversationState`` (message.go:49-56).
+- ``Message`` with retry accounting, timeout, scheduled_at and free-form
+  metadata (message.go:58-74); defaults max_retries=3, timeout=30s set by
+  the constructor (message.go:76-91).
+- ``Conversation`` (message.go:93-109) and ``QueueStats`` (message.go:111-121).
+
+Differences from the reference (deliberate):
+
+- Timestamps are floats (UNIX seconds) produced by an injectable clock so
+  TTL/retry timing is testable with a fake clock (the reference hard-codes
+  ``time.Now()`` everywhere and its tests must really sleep).
+- ``Message.to_dict``/``from_dict`` give a stable wire format (the
+  reference relies on Go JSON tags).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Priority(enum.IntEnum):
+    """Priority tiers; lower value = more urgent (reference message.go:15-22)."""
+
+    REALTIME = 1
+    HIGH = 2
+    NORMAL = 3
+    LOW = 4
+
+    @property
+    def tier_name(self) -> str:
+        return _PRIORITY_NAMES[self]
+
+    @classmethod
+    def from_name(cls, name: str) -> "Priority":
+        try:
+            return _PRIORITY_BY_NAME[name.strip().lower()]
+        except KeyError:
+            raise ValueError(f"unknown priority name: {name!r}")
+
+    @classmethod
+    def parse(cls, value: Any) -> "Priority":
+        """Accept Priority, int, numeric string or tier name."""
+        if isinstance(value, Priority):
+            return value
+        if isinstance(value, int):
+            return cls(value)
+        if isinstance(value, str):
+            v = value.strip().lower()
+            if v.isdigit():
+                return cls(int(v))
+            return cls.from_name(v)
+        raise TypeError(f"cannot parse priority from {value!r}")
+
+
+_PRIORITY_NAMES = {
+    Priority.REALTIME: "realtime",
+    Priority.HIGH: "high",
+    Priority.NORMAL: "normal",
+    Priority.LOW: "low",
+}
+_PRIORITY_BY_NAME = {v: k for k, v in _PRIORITY_NAMES.items()}
+
+#: Tier names in urgency order — the canonical queue names.
+PRIORITY_TIERS = tuple(_PRIORITY_NAMES[p] for p in Priority)
+
+
+class MessageStatus(str, enum.Enum):
+    """Message lifecycle (reference message.go:39-47)."""
+
+    PENDING = "pending"
+    PROCESSING = "processing"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    TIMEOUT = "timeout"
+
+
+class ConversationState(str, enum.Enum):
+    """Conversation lifecycle (reference message.go:49-56)."""
+
+    ACTIVE = "active"
+    PAUSED = "paused"
+    COMPLETED = "completed"
+    EXPIRED = "expired"
+
+
+def new_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class Message:
+    """A unit of LLM work flowing through the queue plane.
+
+    Field parity with reference message.go:58-74; constructor defaults
+    (max_retries=3, timeout=30.0) from message.go:76-91.
+    """
+
+    id: str = field(default_factory=new_id)
+    conversation_id: str = ""
+    user_id: str = ""
+    content: str = ""
+    priority: Priority = Priority.NORMAL
+    status: MessageStatus = MessageStatus.PENDING
+    retry_count: int = 0
+    max_retries: int = 3
+    timeout: float = 30.0
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    scheduled_at: Optional[float] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    # Filled by the execution plane:
+    response: str = ""
+    error: str = ""
+
+    def __post_init__(self) -> None:
+        self.priority = Priority.parse(self.priority)
+        if not isinstance(self.status, MessageStatus):
+            self.status = MessageStatus(self.status)
+
+    def touch(self, now: Optional[float] = None) -> None:
+        self.updated_at = time.time() if now is None else now
+
+    def can_retry(self) -> bool:
+        return self.retry_count < self.max_retries
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "conversation_id": self.conversation_id,
+            "user_id": self.user_id,
+            "content": self.content,
+            "priority": int(self.priority),
+            "status": self.status.value,
+            "retry_count": self.retry_count,
+            "max_retries": self.max_retries,
+            "timeout": self.timeout,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+            "scheduled_at": self.scheduled_at,
+            "metadata": self.metadata,
+            "response": self.response,
+            "error": self.error,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Message":
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class Conversation:
+    """Multi-turn conversation state (reference message.go:93-109)."""
+
+    id: str = field(default_factory=new_id)
+    user_id: str = ""
+    state: ConversationState = ConversationState.ACTIVE
+    messages: List[Message] = field(default_factory=list)
+    context: str = ""
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    last_active_at: float = field(default_factory=time.time)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.state, ConversationState):
+            self.state = ConversationState(self.state)
+
+    def to_dict(self, include_messages: bool = True) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "id": self.id,
+            "user_id": self.user_id,
+            "state": self.state.value,
+            "context": self.context,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+            "last_active_at": self.last_active_at,
+            "metadata": self.metadata,
+            "message_count": len(self.messages),
+        }
+        if include_messages:
+            d["messages"] = [m.to_dict() for m in self.messages]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Conversation":
+        d = dict(d)
+        d.pop("message_count", None)
+        msgs = [Message.from_dict(m) for m in d.pop("messages", [])]
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        conv = cls(**{k: v for k, v in d.items() if k in known})
+        conv.messages = msgs
+        return conv
+
+
+@dataclass
+class QueueStats:
+    """Per-queue statistics (reference message.go:111-121)."""
+
+    queue_name: str = ""
+    pending_count: int = 0
+    processing_count: int = 0
+    completed_count: int = 0
+    failed_count: int = 0
+    total_wait_time: float = 0.0
+    total_process_time: float = 0.0
+
+    @property
+    def avg_wait_time(self) -> float:
+        done = self.completed_count + self.failed_count
+        return self.total_wait_time / done if done else 0.0
+
+    @property
+    def avg_process_time(self) -> float:
+        done = self.completed_count + self.failed_count
+        return self.total_process_time / done if done else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "queue_name": self.queue_name,
+            "pending_count": self.pending_count,
+            "processing_count": self.processing_count,
+            "completed_count": self.completed_count,
+            "failed_count": self.failed_count,
+            "avg_wait_time": self.avg_wait_time,
+            "avg_process_time": self.avg_process_time,
+        }

@@ -1,0 +1,1 @@
+from llmq_tpu.metrics.registry import QueueMetrics, exposition, REGISTRY  # noqa: F401

@@ -1,0 +1,102 @@
+"""Prometheus metrics, namespace ``llm_queue``.
+
+Parity with the reference's seven metric families
+(queue_manager.go:77-156): pending/processing gauges, completed/failed
+counters, wait/process-time histograms, operations counter — plus
+executor-plane families the reference cannot have (decode steps, KV pages).
+
+Two reference gaps fixed here:
+
+- The reference never mounts promhttp (SURVEY.md §5 "Metrics") — our API
+  server serves :ref:`exposition` at ``/metrics``.
+- ``CompleteMessage`` labels priority ``"unknown"``
+  (queue_manager.go:388-389) — we track the message's priority and label
+  correctly.
+
+Metric families are process-level singletons so tests creating many
+QueueManagers don't trip duplicate registration (the reference's tests
+disable metrics entirely for this reason, tests/queue_factory_test.go:24).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+_NAMESPACE = "llm_queue"
+_LOCK = threading.Lock()
+_SINGLETON: Optional["QueueMetrics"] = None
+
+_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 300)
+_PROC_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
+
+
+class QueueMetrics:
+    """The 7 queue-plane families (queue_manager.go:77-156) + executor families."""
+
+    def __init__(self, registry: CollectorRegistry) -> None:
+        ns = _NAMESPACE
+        labels = ["manager", "queue", "priority"]
+        self.pending = Gauge(
+            f"{ns}_messages_pending", "Pending messages per queue", labels,
+            registry=registry)
+        self.processing = Gauge(
+            f"{ns}_messages_processing", "In-flight messages per queue", labels,
+            registry=registry)
+        self.completed = Counter(
+            f"{ns}_messages_completed_total", "Completed messages", labels,
+            registry=registry)
+        self.failed = Counter(
+            f"{ns}_messages_failed_total", "Failed messages", labels,
+            registry=registry)
+        self.wait_time = Histogram(
+            f"{ns}_message_wait_seconds", "Queue wait time", labels,
+            buckets=_WAIT_BUCKETS, registry=registry)
+        self.process_time = Histogram(
+            f"{ns}_message_process_seconds", "Processing time", labels,
+            buckets=_PROC_BUCKETS, registry=registry)
+        self.operations = Counter(
+            f"{ns}_operations_total", "Queue operations",
+            ["manager", "operation", "status"], registry=registry)
+        # Execution plane (new scope):
+        self.decode_steps = Counter(
+            f"{ns}_decode_steps_total", "Engine decode steps", ["engine"],
+            registry=registry)
+        self.generated_tokens = Counter(
+            f"{ns}_generated_tokens_total", "Tokens generated", ["engine", "priority"],
+            registry=registry)
+        self.kv_pages_in_use = Gauge(
+            f"{ns}_kv_pages_in_use", "Paged KV cache pages in use", ["engine"],
+            registry=registry)
+        self.kv_pinned_conversations = Gauge(
+            f"{ns}_kv_pinned_conversations", "Conversations with pinned KV", ["engine"],
+            registry=registry)
+        self.batch_occupancy = Gauge(
+            f"{ns}_batch_occupancy", "Decode-slot occupancy", ["engine"],
+            registry=registry)
+        self.preemptions = Counter(
+            f"{ns}_preemptions_total", "Step-boundary preemptions",
+            ["engine", "priority"], registry=registry)
+
+
+def get_metrics() -> QueueMetrics:
+    global _SINGLETON
+    with _LOCK:
+        if _SINGLETON is None:
+            _SINGLETON = QueueMetrics(REGISTRY)
+        return _SINGLETON
+
+
+def exposition() -> bytes:
+    """Prometheus text exposition for the API server's /metrics route."""
+    return generate_latest(REGISTRY)

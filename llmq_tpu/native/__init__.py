@@ -1,0 +1,10 @@
+"""Native (C++) queue core loader.
+
+Builds ``native/src/mlq.cpp`` into ``_libmlq.so`` on first use (g++ is part
+of the toolchain) and exposes it via ctypes. If the build or load fails the
+queue plane transparently falls back to the pure-Python heap implementation
+— same observable semantics, verified by the shared test suite running
+against both backends (tests/test_priority_queue.py).
+"""
+
+from llmq_tpu.native.loader import load_native, NativeMLQ, native_available  # noqa: F401

@@ -1,0 +1,21 @@
+"""Queue plane (L2 core): multi-level priority queues, manager, workers,
+delayed queue, dead-letter queue, factory.
+
+Parity with reference ``internal/priorityqueue`` (SURVEY.md §2 #3-#8), with
+the reference's dangling integrations actually wired here:
+
+- Worker retries go through the DelayedQueue (the reference re-pushes
+  immediately and admits it in a comment, worker.go:227-229).
+- Exhausted retries land in the DeadLetterQueue (standalone in the
+  reference, SURVEY.md #7).
+- QueueFactory's "delayed"/"dead_letter" queue types do something
+  (empty switch arms in the reference, queue_factory.go:193-200).
+- Stale-message cleanup is real (stub at queue_manager.go:549-553).
+"""
+
+from llmq_tpu.queueing.priority_queue import MultiLevelQueue  # noqa: F401
+from llmq_tpu.queueing.queue_manager import QueueManager, PriorityAdjustRule  # noqa: F401
+from llmq_tpu.queueing.worker import Worker, ExponentialBackoff, FixedBackoff  # noqa: F401
+from llmq_tpu.queueing.delayed_queue import DelayedQueue  # noqa: F401
+from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue, DeadLetterItem  # noqa: F401
+from llmq_tpu.queueing.factory import QueueFactory, QueueType  # noqa: F401

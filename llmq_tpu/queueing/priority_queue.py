@@ -1,0 +1,403 @@
+"""Multi-level priority queue.
+
+Parity with reference ``internal/priorityqueue/queue.go``:
+
+- named queues, each a min-heap ordered by (priority asc, FIFO within
+  priority) (queue.go:22-27, 52-68)
+- capacity check → ``QueueFullError`` (queue.go:92-119)
+- ``push``/``pop``/``peek``/``size``/``get_stats``/``get_all_stats``
+  (queue.go:92-186)
+- stat transitions pending→processing→completed/failed
+  (queue.go:197-211), wait time recorded at pop
+
+TPU-build differences:
+
+- The ordering heap runs in C++ (native/src/mlq.cpp) via ctypes when
+  available, with a pure-Python heapq fallback of identical semantics
+  (select with ``backend=``; the test suite runs against both).
+- ``expire_older_than`` implements the stale-message cleanup the reference
+  stubs (queue_manager.go:549-553) via tombstones: expired messages are
+  marked TIMEOUT immediately and discarded when the heap surfaces them.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.errors import (
+    QueueEmptyError,
+    QueueFullError,
+    QueueNotFoundError,
+)
+from llmq_tpu.core.types import Message, MessageStatus, QueueStats
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("priorityqueue")
+
+
+class _PyBackend:
+    """Pure-Python heap backend; mirrors the C ABI of native/src/mlq.cpp."""
+
+    ERR_NOT_FOUND = -1
+    ERR_FULL = -2
+    ERR_EMPTY = -3
+    ERR_EXISTS = -4
+
+    def __init__(self) -> None:
+        self._heaps: Dict[str, List[Tuple[int, int, int, float]]] = {}
+        self._caps: Dict[str, int] = {}
+        self._stats: Dict[str, List[float]] = {}  # [pend, proc, comp, fail, wait, ptime]
+        self._seq = itertools.count(1)
+        self._mu = threading.Lock()
+
+    def create_queue(self, name: str, capacity: int) -> int:
+        with self._mu:
+            if name in self._heaps:
+                return self.ERR_EXISTS
+            self._heaps[name] = []
+            self._caps[name] = capacity
+            self._stats[name] = [0, 0, 0, 0, 0.0, 0.0]
+            return 0
+
+    def remove_queue(self, name: str) -> int:
+        with self._mu:
+            if name not in self._heaps:
+                return self.ERR_NOT_FOUND
+            del self._heaps[name], self._caps[name], self._stats[name]
+            return 0
+
+    def has_queue(self, name: str) -> bool:
+        with self._mu:
+            return name in self._heaps
+
+    def push(self, name: str, handle: int, priority: int, enqueue_ts: float) -> int:
+        with self._mu:
+            heap = self._heaps.get(name)
+            if heap is None:
+                return self.ERR_NOT_FOUND
+            cap = self._caps[name]
+            if cap > 0 and len(heap) >= cap:
+                return self.ERR_FULL
+            heapq.heappush(heap, (priority, next(self._seq), handle, enqueue_ts))
+            self._stats[name][0] += 1
+            return 0
+
+    def pop(self, name: str, now: float) -> Tuple[int, int, float]:
+        with self._mu:
+            heap = self._heaps.get(name)
+            if heap is None:
+                return self.ERR_NOT_FOUND, 0, 0.0
+            if not heap:
+                return self.ERR_EMPTY, 0, 0.0
+            _, _, handle, ts = heapq.heappop(heap)
+            wait = max(0.0, now - ts)
+            s = self._stats[name]
+            s[0] -= 1
+            s[1] += 1
+            s[4] += wait
+            return 0, handle, wait
+
+    def peek(self, name: str) -> Tuple[int, int]:
+        with self._mu:
+            heap = self._heaps.get(name)
+            if heap is None:
+                return self.ERR_NOT_FOUND, 0
+            if not heap:
+                return self.ERR_EMPTY, 0
+            return 0, heap[0][2]
+
+    def pop_if(self, name: str, expected_handle: int, now: float) -> int:
+        with self._mu:
+            heap = self._heaps.get(name)
+            if heap is None:
+                return self.ERR_NOT_FOUND
+            if not heap:
+                return self.ERR_EMPTY
+            if heap[0][2] != expected_handle:
+                return -5  # mismatch: top changed under us
+            _, _, _, ts = heapq.heappop(heap)
+            s = self._stats[name]
+            s[0] -= 1
+            s[1] += 1
+            s[4] += max(0.0, now - ts)
+            return 0
+
+    def size(self, name: str) -> int:
+        with self._mu:
+            heap = self._heaps.get(name)
+            return self.ERR_NOT_FOUND if heap is None else len(heap)
+
+    def complete(self, name: str, process_time: float) -> int:
+        with self._mu:
+            s = self._stats.get(name)
+            if s is None:
+                return self.ERR_NOT_FOUND
+            if s[1] > 0:
+                s[1] -= 1
+            s[2] += 1
+            s[5] += process_time
+            return 0
+
+    def fail(self, name: str, process_time: float) -> int:
+        with self._mu:
+            s = self._stats.get(name)
+            if s is None:
+                return self.ERR_NOT_FOUND
+            if s[1] > 0:
+                s[1] -= 1
+            s[3] += 1
+            s[5] += process_time
+            return 0
+
+    def requeue_accounting(self, name: str) -> int:
+        with self._mu:
+            s = self._stats.get(name)
+            if s is None:
+                return self.ERR_NOT_FOUND
+            if s[1] > 0:
+                s[1] -= 1
+            return 0
+
+    def stats(self, name: str) -> Tuple[int, List[int], List[float]]:
+        with self._mu:
+            s = self._stats.get(name)
+            if s is None:
+                return self.ERR_NOT_FOUND, [], []
+            return 0, [int(x) for x in s[:4]], list(s[4:])
+
+    def queue_names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._heaps)
+
+
+def _make_backend(backend: str):
+    if backend in ("auto", "native"):
+        try:
+            from llmq_tpu.native.loader import NativeMLQ
+            return NativeMLQ()
+        except Exception as e:  # noqa: BLE001
+            if backend == "native":
+                raise
+            log.info("using Python queue backend (%s)", e)
+    return _PyBackend()
+
+
+class MultiLevelQueue:
+    """Named priority queues sharing one ordering core.
+
+    ``backend``: "auto" (native if buildable), "native", or "python".
+    """
+
+    ERR_NOT_FOUND = -1
+    ERR_FULL = -2
+    ERR_EMPTY = -3
+    ERR_EXISTS = -4
+
+    def __init__(self, clock: Optional[Clock] = None, backend: str = "auto") -> None:
+        self._clock = clock or SYSTEM_CLOCK
+        self._core = _make_backend(backend)
+        self.backend_name = type(self._core).__name__
+        # handle → (queue_name, Message, enqueue_ts); Python owns Message objects.
+        self._messages: Dict[int, Tuple[str, Message, float]] = {}
+        self._tombstones: set[int] = set()
+        self._caps: Dict[str, int] = {}
+        self._next_handle = itertools.count(1)
+        self._mu = threading.Lock()
+
+    # -- queue management ----------------------------------------------------
+
+    def create_queue(self, name: str, capacity: int = 0) -> None:
+        err = self._core.create_queue(name, capacity)
+        if err == self.ERR_EXISTS:
+            return  # idempotent, like CreateQueue on an existing name
+        with self._mu:
+            self._caps[name] = capacity
+
+    def remove_queue(self, name: str) -> None:
+        err = self._core.remove_queue(name)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        with self._mu:
+            self._caps.pop(name, None)
+            gone = [h for h, (qn, _, _) in self._messages.items() if qn == name]
+            for h in gone:
+                self._messages.pop(h, None)
+                self._tombstones.discard(h)
+
+    def has_queue(self, name: str) -> bool:
+        return self._core.has_queue(name)
+
+    def queue_names(self) -> List[str]:
+        return self._core.queue_names()
+
+    # -- data path -----------------------------------------------------------
+
+    def push(self, name: str, message: Message) -> None:
+        now = self._clock.now()
+        handle = next(self._next_handle)
+        with self._mu:
+            self._messages[handle] = (name, message, now)
+        err = self._core.push(name, handle, int(message.priority), now)
+        if err == 0:
+            message.status = MessageStatus.PENDING
+            message.touch(now)
+            return
+        with self._mu:
+            self._messages.pop(handle, None)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        if err == self.ERR_FULL:
+            raise QueueFullError(name, self._caps.get(name, 0))
+        raise RuntimeError(f"push failed: err={err}")
+
+    def pop(self, name: str) -> Message:
+        """Most urgent message; moves it to PROCESSING. Tombstoned (expired)
+        entries surfacing here are converted to failed accounting and
+        skipped. The measured queue wait is attached to the message as
+        ``last_wait_time`` (metadata consumers use it rather than
+        re-deriving from created_at, which may be on a different clock)."""
+        while True:
+            err, handle, wait = self._core.pop(name, self._clock.now())
+            if err == self.ERR_NOT_FOUND:
+                raise QueueNotFoundError(name)
+            if err == self.ERR_EMPTY:
+                raise QueueEmptyError(name)
+            with self._mu:
+                tomb = handle in self._tombstones
+                if tomb:
+                    self._tombstones.discard(handle)
+                    self._messages.pop(handle, None)
+                else:
+                    entry = self._messages.pop(handle, None)
+            if tomb:
+                self._core.fail(name, 0.0)
+                continue
+            if entry is None:
+                # Shouldn't happen; treat as spurious and continue.
+                self._core.fail(name, 0.0)
+                continue
+            _, message, _ = entry
+            message.status = MessageStatus.PROCESSING
+            message.last_wait_time = wait  # type: ignore[attr-defined]
+            message.touch(self._clock.now())
+            return message
+
+    def try_pop(self, name: str) -> Optional[Message]:
+        try:
+            return self.pop(name)
+        except QueueEmptyError:
+            return None
+
+    def peek(self, name: str) -> Message:
+        while True:
+            err, handle = self._core.peek(name)
+            if err == self.ERR_NOT_FOUND:
+                raise QueueNotFoundError(name)
+            if err == self.ERR_EMPTY:
+                raise QueueEmptyError(name)
+            with self._mu:
+                if handle in self._tombstones:
+                    entry = None
+                    tomb = True
+                else:
+                    entry = self._messages.get(handle)
+                    tomb = False
+            if not tomb and entry is not None:
+                return entry[1]
+            # Drain the dead entry so peek makes progress — atomically, so a
+            # concurrent push that changed the heap top is never discarded.
+            popped = self._core.pop_if(name, handle, self._clock.now())
+            if popped == 0:
+                self._core.fail(name, 0.0)
+                with self._mu:
+                    self._tombstones.discard(handle)
+                    self._messages.pop(handle, None)
+            # On mismatch (-5) or empty, just re-peek.
+
+    def size(self, name: str) -> int:
+        n = self._core.size(name)
+        if n == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        with self._mu:
+            tomb_here = sum(
+                1 for h in self._tombstones
+                if h in self._messages and self._messages[h][0] == name)
+        return max(0, n - tomb_here)
+
+    def total_size(self) -> int:
+        return sum(self.size(n) for n in self.queue_names())
+
+    # -- stat transitions (queue.go:197-211) ---------------------------------
+
+    def complete_message(self, name: str, message: Message,
+                         process_time: float = 0.0) -> None:
+        err = self._core.complete(name, process_time)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        message.status = MessageStatus.COMPLETED
+        message.touch(self._clock.now())
+
+    def fail_message(self, name: str, message: Message,
+                     process_time: float = 0.0) -> None:
+        err = self._core.fail(name, process_time)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        message.status = MessageStatus.FAILED
+        message.touch(self._clock.now())
+
+    def requeue(self, name: str, message: Message) -> None:
+        """Return a popped (PROCESSING) message to the queue without
+        counting it completed/failed — the retry path."""
+        self.requeue_accounting_for(name)
+        self.push(name, message)
+
+    def requeue_accounting_for(self, name: str) -> None:
+        """Move a popped message out of PROCESSING stats without a
+        completed/failed transition (it will re-enter later, e.g. via the
+        delayed queue after a retry backoff)."""
+        err = self._core.requeue_accounting(name)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+
+    # -- stale cleanup (real version of queue_manager.go:549-553) ------------
+
+    def expire_older_than(self, name: str, max_age: float) -> List[Message]:
+        """Mark pending messages older than ``max_age`` as TIMEOUT.
+
+        They are tombstoned and will be discarded (with failed accounting)
+        when the heap surfaces them; reported sizes exclude them
+        immediately."""
+        if not self.has_queue(name):
+            raise QueueNotFoundError(name)
+        cutoff = self._clock.now() - max_age
+        expired: List[Message] = []
+        with self._mu:
+            for h, (qn, msg, ts) in self._messages.items():
+                if qn == name and ts < cutoff and h not in self._tombstones:
+                    self._tombstones.add(h)
+                    msg.status = MessageStatus.TIMEOUT
+                    expired.append(msg)
+        return expired
+
+    # -- stats ---------------------------------------------------------------
+
+    def get_stats(self, name: str) -> QueueStats:
+        err, ints, floats = self._core.stats(name)
+        if err == self.ERR_NOT_FOUND:
+            raise QueueNotFoundError(name)
+        return QueueStats(
+            queue_name=name,
+            pending_count=ints[0],
+            processing_count=ints[1],
+            completed_count=ints[2],
+            failed_count=ints[3],
+            total_wait_time=floats[0],
+            total_process_time=floats[1],
+        )
+
+    def get_all_stats(self) -> Dict[str, QueueStats]:
+        return {n: self.get_stats(n) for n in self.queue_names()}

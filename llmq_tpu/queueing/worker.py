@@ -1,0 +1,280 @@
+"""Batch consumer workers.
+
+Parity with reference ``internal/priorityqueue/worker.go``:
+
+- ticker-driven loop: every ``process_interval`` pop up to
+  ``max_batch_size`` messages, each processed concurrently under a
+  ``max_concurrent`` semaphore (worker.go:109-159)
+- per-message deadline from ``message.timeout`` (:166) — cooperative here:
+  the :class:`ProcessContext` handed to the process function exposes
+  ``deadline``/``cancelled``; overruns are recorded as timeout failures
+- pluggable ``process_fn(ctx, message)`` — the execution seam where the
+  TPU engine plugs in (:33; BASELINE north star)
+- failure → backoff + retry until ``max_retries`` (:202-239), then fail
+- ``ExponentialBackoff`` (:258-294) and ``FixedBackoff`` (:297-315)
+- per-worker metrics (:42-49)
+
+Fixes over the reference (SURVEY.md #5-#7):
+
+- retries are scheduled through the :class:`DelayedQueue` honoring the
+  backoff delay (the reference re-pushes immediately and admits it in a
+  comment, worker.go:227-229)
+- exhausted retries are pushed to the :class:`DeadLetterQueue` (unwired in
+  the reference)
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.config import RetryConfig, WorkerConfig
+from llmq_tpu.core.types import Message, MessageStatus
+from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
+from llmq_tpu.queueing.delayed_queue import DelayedQueue
+from llmq_tpu.queueing.queue_manager import QueueManager
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("worker")
+
+
+class ProcessContext:
+    """Cooperative cancellation + deadline for one message."""
+
+    def __init__(self, deadline: Optional[float], clock: Clock) -> None:
+        self.deadline = deadline
+        self._clock = clock
+        self._cancelled = threading.Event()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - self._clock.now()
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+
+ProcessFn = Callable[[ProcessContext, Message], None]
+
+
+class BackoffStrategy:
+    """Interface parity with worker.go:36-39."""
+
+    def next_backoff(self, retry_count: int) -> float:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ExponentialBackoff(BackoffStrategy):
+    """initial · multiplier^(retry-1), capped (worker.go:258-294)."""
+
+    def __init__(self, initial: float = 1.0, maximum: float = 60.0,
+                 multiplier: float = 2.0) -> None:
+        self.initial = initial
+        self.maximum = maximum
+        self.multiplier = multiplier
+
+    def next_backoff(self, retry_count: int) -> float:
+        d = self.initial * (self.multiplier ** max(0, retry_count - 1))
+        return min(d, self.maximum)
+
+
+class FixedBackoff(BackoffStrategy):
+    """Constant delay (worker.go:297-315)."""
+
+    def __init__(self, delay: float = 1.0) -> None:
+        self.delay = delay
+
+    def next_backoff(self, retry_count: int) -> float:
+        return self.delay
+
+
+@dataclass
+class WorkerStats:
+    """Per-worker counters (worker.go:42-49)."""
+
+    processed: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    retried: int = 0
+    dead_lettered: int = 0
+    timeouts: int = 0
+    total_process_time: float = 0.0
+    _mu: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def to_dict(self) -> Dict[str, float]:
+        with self._mu:
+            return {
+                "processed": self.processed,
+                "succeeded": self.succeeded,
+                "failed": self.failed,
+                "retried": self.retried,
+                "dead_lettered": self.dead_lettered,
+                "timeouts": self.timeouts,
+                "avg_process_time": (
+                    self.total_process_time / self.processed if self.processed else 0.0),
+            }
+
+
+class Worker:
+    def __init__(
+        self,
+        name: str,
+        manager: QueueManager,
+        process_fn: ProcessFn,
+        worker_config: Optional[WorkerConfig] = None,
+        retry_config: Optional[RetryConfig] = None,
+        backoff: Optional[BackoffStrategy] = None,
+        delayed_queue: Optional[DelayedQueue] = None,
+        dead_letter_queue: Optional[DeadLetterQueue] = None,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.name = name
+        self.manager = manager
+        self.process_fn = process_fn
+        self.wconfig = worker_config or manager.config.queue.worker
+        self.rconfig = retry_config or manager.config.queue.retry
+        self._clock = clock or SYSTEM_CLOCK
+        self.backoff = backoff or self._backoff_from_config()
+        self.delayed_queue = delayed_queue
+        self.dead_letter_queue = dead_letter_queue
+        self.stats = WorkerStats()
+        self._sem = threading.Semaphore(self.wconfig.max_concurrent)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _backoff_from_config(self) -> BackoffStrategy:
+        r = self.rconfig
+        if r.strategy == "fixed":
+            return FixedBackoff(r.initial_backoff)
+        return ExponentialBackoff(r.initial_backoff, r.max_backoff, r.backoff_multiplier)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.wconfig.max_concurrent,
+            thread_name_prefix=f"worker-{self.name}")
+        self._thread = threading.Thread(
+            target=self._process_loop, name=f"worker-loop-{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self, wait: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=wait)
+            self._pool = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- processing (worker.go:109-159) --------------------------------------
+
+    def _process_loop(self) -> None:
+        while not self._stop.wait(self.wconfig.process_interval):
+            try:
+                self.process_batch()
+            except Exception:  # noqa: BLE001
+                log.exception("worker %s batch failed", self.name)
+
+    def process_batch(self) -> int:
+        """Pop up to max_batch_size in priority order and dispatch.
+        Returns the number of messages dispatched. Callable directly from
+        tests (no loop needed)."""
+        batch = self.manager.drain_in_priority_order(self.wconfig.max_batch_size)
+        for msg in batch:
+            self._sem.acquire()
+            if self._pool is not None:
+                self._pool.submit(self._run_one, msg)
+            else:  # synchronous mode (tests, echo bench)
+                self._run_one(msg)
+        return len(batch)
+
+    def process_one_sync(self, msg: Message) -> None:
+        """Process a single already-popped message synchronously."""
+        self._sem.acquire()
+        self._run_one(msg)
+
+    def _run_one(self, msg: Message) -> None:
+        try:
+            self._process_message(msg)
+        finally:
+            self._sem.release()
+
+    def _process_message(self, msg: Message) -> None:
+        start = self._clock.now()
+        deadline = start + msg.timeout if msg.timeout and msg.timeout > 0 else None
+        ctx = ProcessContext(deadline, self._clock)
+        err: Optional[BaseException] = None
+        try:
+            self.process_fn(ctx, msg)
+        except BaseException as e:  # noqa: BLE001 — any failure enters retry path
+            err = e
+        elapsed = self._clock.now() - start
+        timed_out = ctx.expired()
+        with self.stats._mu:
+            self.stats.processed += 1
+            self.stats.total_process_time += elapsed
+            if timed_out:
+                self.stats.timeouts += 1
+        if err is None and not timed_out:
+            self.manager.complete_message(msg, elapsed)
+            with self.stats._mu:
+                self.stats.succeeded += 1
+            return
+        reason = f"timeout after {elapsed:.3f}s" if timed_out and err is None else repr(err)
+        self._handle_failure(msg, reason, elapsed, timed_out)
+
+    # -- failure path (worker.go:202-239, properly wired) --------------------
+
+    def _handle_failure(self, msg: Message, reason: str, elapsed: float,
+                        timed_out: bool) -> None:
+        msg.retry_count += 1
+        msg.error = reason
+        if msg.can_retry():
+            delay = self.backoff.next_backoff(msg.retry_count)
+            with self.stats._mu:
+                self.stats.retried += 1
+            if self.delayed_queue is not None:
+                # Proper wiring: requeue accounting now, delivery after the
+                # backoff delay (fixes worker.go:227-229's immediate re-push).
+                qname = self.manager.stash_for_retry(msg)
+                msg.status = MessageStatus.PENDING
+                self.delayed_queue.schedule_after(msg, delay, qname)
+            else:
+                msg.scheduled_at = self._clock.now() + delay
+                qname = self.manager.requeue_message(msg)
+            log.info("message %s retry %d/%d in %.2fs (%s)",
+                     msg.id, msg.retry_count, msg.max_retries, delay, reason)
+            return
+        qname = self.manager._pop_inflight(msg.id) or self.manager.route_for(msg)
+        self.manager.fail_message(msg, elapsed, qname)
+        if timed_out:
+            msg.status = MessageStatus.TIMEOUT
+        with self.stats._mu:
+            self.stats.failed += 1
+        if self.dead_letter_queue is not None:
+            self.dead_letter_queue.push(msg, reason, qname)
+            with self.stats._mu:
+                self.stats.dead_lettered += 1
+        log.warning("message %s failed permanently after %d retries: %s",
+                    msg.id, msg.retry_count, reason)

@@ -1,0 +1,1 @@
+from llmq_tpu.utils.logging import get_logger, configure_logging  # noqa: F401

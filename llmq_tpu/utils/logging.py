@@ -1,0 +1,56 @@
+"""Structured logging.
+
+The reference mixes structured zap (internal/*) with plain ``log``
+(cmd/{api-gateway,queue-manager,scheduler}) — SURVEY.md §5. Here one
+configuration serves every component: JSON or console format per
+``LoggingConfig`` (config.go:95-99 analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+_CONFIGURED = False
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if extra:
+            out.update(extra)
+        return json.dumps(out, default=str)
+
+
+def configure_logging(level: str = "info", fmt: str = "json", output: str = "stdout") -> None:
+    global _CONFIGURED
+    root = logging.getLogger("llmq")
+    root.handlers.clear()
+    stream = sys.stdout if output == "stdout" else sys.stderr
+    handler = logging.StreamHandler(stream)
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-5s %(name)s %(message)s"))
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    if not _CONFIGURED:
+        configure_logging()
+    return logging.getLogger(f"llmq.{name}")

@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Sets up the virtual 8-device CPU mesh BEFORE any jax import so sharding
+tests exercise real multi-device code paths without TPU hardware
+(SURVEY.md §4: "a CPU/jax emulated-device path so TPU code paths run in CI
+without a TPU").
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from llmq_tpu.core.clock import FakeClock  # noqa: E402
+
+
+@pytest.fixture
+def fake_clock() -> FakeClock:
+    return FakeClock()
+
+
+@pytest.fixture(params=["python", "native"])
+def queue_backend(request) -> str:
+    """Every queue test runs against both the pure-Python and the C++
+    native ordering core."""
+    if request.param == "native":
+        from llmq_tpu.native.loader import native_available
+        if not native_available():
+            pytest.skip("native queue core not buildable here")
+    return request.param

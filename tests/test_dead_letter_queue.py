@@ -1,0 +1,88 @@
+"""DeadLetterQueue tests.
+
+Mirrors reference tests/priorityqueue_test.go:569-698 (push/get/requeue/
+batch-requeue) plus bounded-eviction and handler-failure coverage."""
+
+import pytest
+
+from llmq_tpu.core.errors import MessageNotFoundError
+from llmq_tpu.core.types import Message, MessageStatus
+from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
+from llmq_tpu.queueing.queue_manager import QueueManager
+
+
+@pytest.fixture
+def dlq(fake_clock) -> DeadLetterQueue:
+    return DeadLetterQueue(max_size=3, clock=fake_clock)
+
+
+class TestPush:
+    def test_push_and_get(self, dlq, fake_clock):
+        m = Message(content="dead")
+        m.retry_count = 3
+        item = dlq.push(m, "kept failing", "normal")
+        assert item.retry_count == 3
+        assert item.failed_at == fake_clock.now()
+        got = dlq.get(m.id)
+        assert got.message.content == "dead"
+        assert got.source_queue == "normal"
+
+    def test_get_missing_raises(self, dlq):
+        with pytest.raises(MessageNotFoundError):
+            dlq.get("nope")
+
+    def test_bounded_evicts_oldest(self, dlq):
+        ms = [Message(content=f"m{i}") for i in range(4)]
+        for m in ms:
+            dlq.push(m, "r", "q")
+        assert dlq.size() == 3
+        with pytest.raises(MessageNotFoundError):
+            dlq.get(ms[0].id)  # oldest evicted
+        assert dlq.get(ms[3].id)
+
+    def test_handlers_invoked(self, dlq):
+        seen = []
+        dlq.add_handler(lambda item: seen.append(item.message.id))
+        m = Message()
+        dlq.push(m, "r", "q")
+        assert seen == [m.id]
+
+    def test_handler_error_swallowed(self, dlq):
+        def bad(item):
+            raise RuntimeError("handler broke")
+        dlq.add_handler(bad)
+        m = Message()
+        dlq.push(m, "r", "q")  # no raise
+        assert dlq.size() == 1
+
+
+class TestRequeue:
+    def test_requeue_resets_state(self, dlq, fake_clock, queue_backend):
+        qm = QueueManager("t", clock=fake_clock, backend=queue_backend,
+                          enable_metrics=False)
+        m = Message(content="retry me")
+        m.retry_count = 3
+        m.status = MessageStatus.FAILED
+        m.error = "boom"
+        dlq.push(m, "boom", "normal")
+        back = dlq.requeue(m.id, qm)
+        assert back.retry_count == 0
+        assert back.status == MessageStatus.PENDING
+        assert back.error == ""
+        assert qm.queue.size("normal") == 1
+        assert dlq.size() == 0
+
+    def test_batch_requeue_all(self, dlq, fake_clock, queue_backend):
+        qm = QueueManager("t", clock=fake_clock, backend=queue_backend,
+                          enable_metrics=False)
+        for i in range(3):
+            dlq.push(Message(content=f"m{i}"), "r", "low")
+        out = dlq.batch_requeue(qm)
+        assert len(out) == 3
+        assert qm.queue.size("low") == 3
+        assert dlq.size() == 0
+
+    def test_clear(self, dlq):
+        dlq.push(Message(), "r", "q")
+        assert dlq.clear() == 1
+        assert dlq.size() == 0

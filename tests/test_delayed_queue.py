@@ -1,0 +1,76 @@
+"""DelayedQueue tests.
+
+Mirrors reference tests/priorityqueue_test.go:471-567 (delayed delivery
+timing) — with a fake clock, so "elapsed >= delay" is exact instead of
+sleep-based."""
+
+import threading
+
+from llmq_tpu.core.clock import FakeClock
+from llmq_tpu.core.types import Message
+from llmq_tpu.queueing.delayed_queue import DelayedQueue
+
+
+class TestScheduling:
+    def test_not_delivered_early(self, fake_clock):
+        out = []
+        dq = DelayedQueue(lambda q, m: out.append((q, m)), clock=fake_clock)
+        dq.schedule_after(Message(content="a"), 5.0, "normal")
+        assert dq.run_due_once() == 0
+        fake_clock.advance(4.99)
+        assert dq.run_due_once() == 0
+        fake_clock.advance(0.02)
+        assert dq.run_due_once() == 1
+        assert out[0][0] == "normal"
+
+    def test_delivery_order_by_ready_time(self, fake_clock):
+        out = []
+        dq = DelayedQueue(lambda q, m: out.append(m.content), clock=fake_clock)
+        dq.schedule_after(Message(content="later"), 10.0)
+        dq.schedule_after(Message(content="sooner"), 1.0)
+        assert dq.peek().content == "sooner"
+        assert dq.next_ready_at() == fake_clock.now() + 1.0
+        fake_clock.advance(20.0)
+        dq.run_due_once()
+        assert out == ["sooner", "later"]
+
+    def test_schedule_sets_scheduled_at(self, fake_clock):
+        dq = DelayedQueue(lambda q, m: None, clock=fake_clock)
+        m = Message()
+        dq.schedule(m, 123.0)
+        assert m.scheduled_at == 123.0
+
+    def test_size(self, fake_clock):
+        dq = DelayedQueue(lambda q, m: None, clock=fake_clock)
+        assert dq.size() == 0
+        dq.schedule_after(Message(), 1.0)
+        assert dq.size() == 1
+
+    def test_delivery_failure_does_not_stop_others(self, fake_clock):
+        out = []
+
+        def deliver(q, m):
+            if m.content == "boom":
+                raise RuntimeError("handler broke")
+            out.append(m.content)
+
+        dq = DelayedQueue(deliver, clock=fake_clock)
+        dq.schedule_after(Message(content="boom"), 1.0)
+        dq.schedule_after(Message(content="ok"), 1.0)
+        fake_clock.advance(2.0)
+        assert dq.run_due_once() == 2
+        assert out == ["ok"]
+
+
+class TestRunLoop:
+    def test_real_time_loop(self):
+        # Real-clock smoke test of the timer loop + re-arm on earlier item
+        # (delayed_queue.go:114-199).
+        delivered = threading.Event()
+        dq = DelayedQueue(lambda q, m: delivered.set())
+        dq.start()
+        try:
+            dq.schedule_after(Message(), 0.05)
+            assert delivered.wait(timeout=5.0)
+        finally:
+            dq.stop()

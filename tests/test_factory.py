@@ -1,0 +1,100 @@
+"""QueueFactory tests.
+
+Mirrors reference tests/queue_factory_test.go:42-211 (manager creation per
+type, idempotent get, worker creation/stop, cleanup) plus the wiring the
+reference's empty switch arms lack."""
+
+import pytest
+
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.queueing.factory import (
+    QueueFactory,
+    QueueType,
+    long_content_rule,
+    vip_rule,
+)
+
+
+@pytest.fixture
+def factory(fake_clock, queue_backend) -> QueueFactory:
+    f = QueueFactory(clock=fake_clock, backend=queue_backend)
+    yield f
+    f.stop_all()
+
+
+class TestManagers:
+    def test_create_idempotent(self, factory):
+        m1 = factory.create_queue_manager("a", start_background=False)
+        m2 = factory.create_queue_manager("a", start_background=False)
+        assert m1 is m2
+        assert factory.manager_names() == ["a"]
+
+    def test_every_manager_fully_wired(self, factory):
+        # Fixes the reference's empty delayed/dead_letter arms
+        # (queue_factory.go:193-200).
+        factory.create_queue_manager("std", QueueType.STANDARD,
+                                     start_background=False)
+        assert factory.get_delayed_queue("std") is not None
+        assert factory.get_dead_letter_queue("std") is not None
+
+    def test_priority_type_installs_demo_rules(self, factory):
+        # VIP → HIGH; >10k chars → LOW (queue_factory.go:211-233).
+        m = factory.create_queue_manager("p", QueueType.PRIORITY,
+                                         start_background=False)
+        rules = {r.name for r in m.list_priority_rules()}
+        assert rules == {"vip_boost", "long_content_demote"}
+
+        vip = Message(content="hi", priority=Priority.LOW, metadata={"vip": True})
+        m.push_message(vip)
+        assert vip.priority == Priority.HIGH
+
+        longmsg = Message(content="x" * 10_001, priority=Priority.NORMAL)
+        m.push_message(longmsg)
+        assert longmsg.priority == Priority.LOW
+
+    def test_standard_type_has_no_rules(self, factory):
+        m = factory.create_queue_manager("s", QueueType.STANDARD,
+                                         start_background=False)
+        assert m.list_priority_rules() == []
+
+    def test_get_missing_returns_none(self, factory):
+        assert factory.get_queue_manager("nope") is None
+
+    def test_remove(self, factory):
+        factory.create_queue_manager("gone", start_background=False)
+        assert factory.remove_queue_manager("gone")
+        assert not factory.remove_queue_manager("gone")
+        assert factory.get_queue_manager("gone") is None
+
+
+class TestWorkers:
+    def test_create_workers_and_stats(self, factory):
+        m = factory.create_queue_manager("w", start_background=False)
+        workers = factory.create_workers("w", 2, lambda ctx, msg: None,
+                                         start=False)
+        assert len(workers) == 2
+        stats = factory.get_worker_stats("w")
+        assert set(stats) == {"w-w0", "w-w1"}
+        assert stats["w-w0"]["processed"] == 0
+
+    def test_workers_share_wiring(self, factory):
+        m = factory.create_queue_manager("w2", start_background=False)
+        [w] = factory.create_workers(
+            "w2", 1, lambda ctx, msg: (_ for _ in ()).throw(RuntimeError("x")),
+            start=False)
+        msg = Message(max_retries=0)
+        m.push_message(msg)
+        w.process_batch()
+        assert factory.get_dead_letter_queue("w2").size() == 1
+
+    def test_unknown_manager_raises(self, factory):
+        with pytest.raises(KeyError):
+            factory.create_workers("missing", 1, lambda ctx, m: None)
+
+    def test_stop_all(self, fake_clock, queue_backend):
+        f = QueueFactory(clock=fake_clock, backend=queue_backend)
+        f.create_queue_manager("x", start_background=False)
+        ws = f.create_workers("x", 2, lambda ctx, m: None, start=True)
+        assert all(w.running for w in ws)
+        f.stop_all()
+        assert all(not w.running for w in ws)

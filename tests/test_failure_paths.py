@@ -1,0 +1,128 @@
+"""Regression tests for message-loss / accounting-leak paths found in
+review: delayed-queue redelivery, DLQ requeue atomicity, stale-expiry
+accounting, try_pop error transparency, peek/push race safety."""
+
+import pytest
+
+from llmq_tpu.core.config import default_config
+from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
+from llmq_tpu.core.types import Message, MessageStatus
+from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
+from llmq_tpu.queueing.delayed_queue import DelayedQueue
+from llmq_tpu.queueing.factory import QueueFactory
+from llmq_tpu.queueing.queue_manager import QueueManager
+
+
+class TestDelayedRedelivery:
+    def test_failed_delivery_is_rescheduled_not_lost(self, fake_clock):
+        attempts = []
+
+        def deliver(q, m):
+            attempts.append(fake_clock.now())
+            if len(attempts) < 3:
+                raise QueueFullError(q, 1)
+
+        dq = DelayedQueue(deliver, clock=fake_clock)
+        dq.schedule_after(Message(content="x"), 1.0, "normal")
+        fake_clock.advance(1.01)
+        dq.run_due_once()
+        assert len(attempts) == 1
+        assert dq.size() == 1  # re-scheduled, not lost
+        fake_clock.advance(DelayedQueue.REDELIVERY_DELAY + 0.01)
+        dq.run_due_once()
+        fake_clock.advance(DelayedQueue.REDELIVERY_DELAY + 0.01)
+        dq.run_due_once()
+        assert len(attempts) == 3
+        assert dq.size() == 0  # finally delivered
+
+    def test_exhausted_redelivery_goes_to_on_drop(self, fake_clock):
+        dropped = []
+
+        def deliver(q, m):
+            raise QueueNotFoundError(q)
+
+        dq = DelayedQueue(deliver, clock=fake_clock,
+                          on_drop=lambda q, m, r: dropped.append((q, m, r)))
+        dq.schedule_after(Message(content="doomed"), 0.5, "gone")
+        for _ in range(DelayedQueue.MAX_DELIVERY_ATTEMPTS + 1):
+            fake_clock.advance(DelayedQueue.REDELIVERY_DELAY + 0.01)
+            dq.run_due_once()
+        assert len(dropped) == 1
+        assert dropped[0][0] == "gone"
+        assert dq.size() == 0
+
+    def test_factory_routes_undeliverable_to_dlq(self, fake_clock, queue_backend):
+        f = QueueFactory(clock=fake_clock, backend=queue_backend)
+        f.create_queue_manager("m", start_background=False)
+        dq = f.get_delayed_queue("m")
+        dlq = f.get_dead_letter_queue("m")
+        m = Message()
+        dq.schedule_after(m, 0.5, "no_such_queue")
+        for _ in range(DelayedQueue.MAX_DELIVERY_ATTEMPTS + 1):
+            fake_clock.advance(DelayedQueue.REDELIVERY_DELAY + 0.01)
+            dq.run_due_once()
+        assert dlq.size() == 1
+        assert dlq.get(m.id).fail_reason.startswith("undeliverable")
+        f.stop_all()
+
+
+class TestDLQRequeueAtomicity:
+    def test_failed_requeue_restores_item(self, fake_clock, queue_backend):
+        cfg = default_config()
+        cfg.queue.max_queue_size = 1
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False)
+        qm.push_message(Message())  # fill the normal queue (capacity 1)
+        dlq = DeadLetterQueue(clock=fake_clock)
+        dead = Message(content="dead")
+        dead.status = MessageStatus.FAILED
+        dead.retry_count = 3
+        dlq.push(dead, "boom", "normal")
+        with pytest.raises(QueueFullError):
+            dlq.requeue(dead.id, qm)
+        # Item restored with its original state — in exactly one place.
+        assert dlq.size() == 1
+        restored = dlq.get(dead.id).message
+        assert restored.status == MessageStatus.FAILED
+        assert restored.retry_count == 3
+
+    def test_batch_requeue_continues_past_full_queue(self, fake_clock, queue_backend):
+        cfg = default_config()
+        cfg.queue.max_queue_size = 1
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False)
+        dlq = DeadLetterQueue(clock=fake_clock)
+        a = Message(content="a")
+        b = Message(content="b")
+        dlq.push(a, "r", "normal")
+        dlq.push(b, "r", "low")
+        qm.push_message(Message())  # normal is now full
+        out = dlq.batch_requeue(qm)
+        # b made it (low queue has room), a stayed in the DLQ.
+        assert [m.content for m in out] == ["b"]
+        assert dlq.size() == 1
+        assert dlq.get(a.id)
+
+
+class TestStaleExpiryAccounting:
+    def test_inflight_map_does_not_leak(self, fake_clock, queue_backend):
+        cfg = default_config()
+        cfg.queue.stale_message_age = 10.0
+        cfg.scheduler.scale_down_threshold = -1
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False)
+        for _ in range(5):
+            qm.push_message(Message())
+        assert len(qm._inflight) == 5
+        fake_clock.advance(60.0)
+        qm.run_monitor_once()
+        assert len(qm._inflight) == 0
+
+
+class TestTryPopTransparency:
+    def test_unknown_queue_raises_not_none(self, fake_clock, queue_backend):
+        qm = QueueManager("t", clock=fake_clock, backend=queue_backend,
+                          enable_metrics=False)
+        with pytest.raises(QueueNotFoundError):
+            qm.try_pop_message("typo_queue")
+        assert qm.try_pop_message("normal") is None  # empty → None

@@ -1,0 +1,176 @@
+"""MultiLevelQueue tests — both backends.
+
+Mirrors reference tests/priorityqueue_test.go:14-239 (push/pop/peek/stats
+ordering) and adds coverage the reference lacks: FIFO tie-break proof,
+tombstone expiry, requeue accounting."""
+
+import pytest
+
+from llmq_tpu.core.clock import FakeClock
+from llmq_tpu.core.errors import (
+    QueueEmptyError,
+    QueueFullError,
+    QueueNotFoundError,
+)
+from llmq_tpu.core.types import Message, MessageStatus, Priority
+from llmq_tpu.queueing.priority_queue import MultiLevelQueue
+
+
+@pytest.fixture
+def mlq(fake_clock, queue_backend) -> MultiLevelQueue:
+    return MultiLevelQueue(clock=fake_clock, backend=queue_backend)
+
+
+class TestOrdering:
+    def test_priority_order(self, mlq):
+        mlq.create_queue("q")
+        for i, p in enumerate([Priority.LOW, Priority.REALTIME,
+                               Priority.NORMAL, Priority.HIGH]):
+            mlq.push("q", Message(content=f"m{i}", priority=p))
+        got = [mlq.pop("q").content for _ in range(4)]
+        assert got == ["m1", "m3", "m2", "m0"]
+
+    def test_fifo_within_priority(self, mlq):
+        # (priority asc, FIFO) — reference queue.go:22-27.
+        mlq.create_queue("q")
+        for i in range(50):
+            mlq.push("q", Message(content=str(i), priority=Priority.NORMAL))
+        got = [mlq.pop("q").content for _ in range(50)]
+        assert got == [str(i) for i in range(50)]
+
+    def test_interleaved(self, mlq):
+        mlq.create_queue("q")
+        mlq.push("q", Message(content="n1", priority=Priority.NORMAL))
+        mlq.push("q", Message(content="r1", priority=Priority.REALTIME))
+        assert mlq.pop("q").content == "r1"
+        mlq.push("q", Message(content="r2", priority=Priority.REALTIME))
+        assert mlq.pop("q").content == "r2"
+        assert mlq.pop("q").content == "n1"
+
+
+class TestLifecycle:
+    def test_capacity(self, mlq):
+        mlq.create_queue("q", capacity=2)
+        mlq.push("q", Message())
+        mlq.push("q", Message())
+        with pytest.raises(QueueFullError):
+            mlq.push("q", Message())
+
+    def test_unknown_queue(self, mlq):
+        with pytest.raises(QueueNotFoundError):
+            mlq.push("nope", Message())
+        with pytest.raises(QueueNotFoundError):
+            mlq.pop("nope")
+        with pytest.raises(QueueNotFoundError):
+            mlq.get_stats("nope")
+
+    def test_empty_pop(self, mlq):
+        mlq.create_queue("q")
+        with pytest.raises(QueueEmptyError):
+            mlq.pop("q")
+        assert mlq.try_pop("q") is None
+
+    def test_peek_does_not_remove(self, mlq):
+        mlq.create_queue("q")
+        mlq.push("q", Message(content="a"))
+        assert mlq.peek("q").content == "a"
+        assert mlq.size("q") == 1
+        assert mlq.pop("q").content == "a"
+
+    def test_create_queue_idempotent(self, mlq):
+        mlq.create_queue("q", capacity=5)
+        mlq.create_queue("q", capacity=99)  # no error, no reset
+        mlq.push("q", Message())
+        assert mlq.size("q") == 1
+
+    def test_remove_queue(self, mlq):
+        mlq.create_queue("q")
+        mlq.push("q", Message())
+        mlq.remove_queue("q")
+        assert not mlq.has_queue("q")
+        with pytest.raises(QueueNotFoundError):
+            mlq.remove_queue("q")
+
+    def test_status_transitions(self, mlq):
+        mlq.create_queue("q")
+        m = Message()
+        mlq.push("q", m)
+        assert m.status == MessageStatus.PENDING
+        m2 = mlq.pop("q")
+        assert m2.status == MessageStatus.PROCESSING
+        mlq.complete_message("q", m2)
+        assert m2.status == MessageStatus.COMPLETED
+
+
+class TestStats:
+    def test_accounting(self, mlq, fake_clock):
+        # Stat transitions (reference queue.go:197-211).
+        mlq.create_queue("q")
+        a, b = Message(), Message()
+        mlq.push("q", a)
+        mlq.push("q", b)
+        fake_clock.advance(4.0)
+        a2 = mlq.pop("q")
+        b2 = mlq.pop("q")
+        mlq.complete_message("q", a2, process_time=1.0)
+        mlq.fail_message("q", b2, process_time=2.0)
+        s = mlq.get_stats("q")
+        assert s.pending_count == 0
+        assert s.processing_count == 0
+        assert s.completed_count == 1
+        assert s.failed_count == 1
+        assert s.total_wait_time == pytest.approx(8.0)  # 4s each
+        assert s.total_process_time == pytest.approx(3.0)
+        assert s.avg_wait_time == pytest.approx(4.0)
+
+    def test_all_stats(self, mlq):
+        mlq.create_queue("a")
+        mlq.create_queue("b")
+        mlq.push("a", Message())
+        stats = mlq.get_all_stats()
+        assert stats["a"].pending_count == 1
+        assert stats["b"].pending_count == 0
+
+    def test_wait_time_attached_to_message(self, mlq, fake_clock):
+        mlq.create_queue("q")
+        mlq.push("q", Message())
+        fake_clock.advance(2.5)
+        m = mlq.pop("q")
+        assert m.last_wait_time == pytest.approx(2.5)
+
+
+class TestExpiry:
+    def test_expire_older_than(self, mlq, fake_clock):
+        mlq.create_queue("q")
+        old = Message(content="old")
+        mlq.push("q", old)
+        fake_clock.advance(100.0)
+        mlq.push("q", Message(content="new"))
+        expired = mlq.expire_older_than("q", max_age=50.0)
+        assert [m.content for m in expired] == ["old"]
+        assert old.status == MessageStatus.TIMEOUT
+        assert mlq.size("q") == 1
+        assert mlq.pop("q").content == "new"
+        assert mlq.get_stats("q").failed_count == 1
+
+    def test_peek_skips_tombstones(self, mlq, fake_clock):
+        mlq.create_queue("q")
+        mlq.push("q", Message(content="old", priority=Priority.REALTIME))
+        fake_clock.advance(100.0)
+        mlq.push("q", Message(content="new"))
+        mlq.expire_older_than("q", max_age=50.0)
+        assert mlq.peek("q").content == "new"
+
+
+class TestRequeue:
+    def test_requeue_keeps_stats_clean(self, mlq):
+        mlq.create_queue("q")
+        m = Message()
+        mlq.push("q", m)
+        popped = mlq.pop("q")
+        mlq.requeue("q", popped)
+        s = mlq.get_stats("q")
+        assert s.pending_count == 1
+        assert s.processing_count == 0
+        assert s.completed_count == 0 and s.failed_count == 0
+        assert mlq.pop("q").id == m.id

@@ -1,0 +1,139 @@
+"""QueueManager tests.
+
+Mirrors reference tests/priorityqueue_test.go:241-363 (manager single +
+batch ops, complete/fail accounting) plus new coverage: tier routing (the
+reference has a latent ErrQueueNotFound bug here, SURVEY.md #16), scale
+signals, real stale cleanup."""
+
+import pytest
+
+from llmq_tpu.core.config import default_config
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.queueing.queue_manager import (
+    PriorityAdjustRule,
+    QueueManager,
+)
+
+
+@pytest.fixture
+def manager(fake_clock, queue_backend) -> QueueManager:
+    return QueueManager("test", clock=fake_clock, backend=queue_backend,
+                        enable_metrics=False)
+
+
+class TestRouting:
+    def test_tier_queues_exist(self, manager):
+        assert set(manager.queue_names()) == {"realtime", "high", "normal", "low"}
+
+    def test_routes_by_priority(self, manager):
+        m = Message(priority=Priority.REALTIME)
+        qname = manager.push_message(m)
+        assert qname == "realtime"
+        assert manager.queue.size("realtime") == 1
+
+    def test_explicit_queue(self, manager):
+        manager.create_queue("custom")
+        manager.push_message(Message(), "custom")
+        assert manager.queue.size("custom") == 1
+
+
+class TestRules:
+    def test_rule_applied_before_push(self, manager):
+        manager.add_priority_rule(PriorityAdjustRule(
+            name="boost", condition=lambda m: "urgent" in m.content,
+            target_priority=Priority.REALTIME))
+        m = Message(content="this is urgent", priority=Priority.LOW)
+        qname = manager.push_message(m)
+        assert m.priority == Priority.REALTIME
+        assert qname == "realtime"
+
+    def test_rule_removal(self, manager):
+        manager.add_priority_rule(PriorityAdjustRule(
+            name="r", condition=lambda m: True, target_priority=Priority.LOW))
+        assert manager.remove_priority_rule("r")
+        assert not manager.remove_priority_rule("r")
+        m = Message(priority=Priority.HIGH)
+        manager.push_message(m)
+        assert m.priority == Priority.HIGH
+
+
+class TestBatchOps:
+    def test_batch_push_pop(self, manager):
+        msgs = [Message(priority=Priority.NORMAL) for _ in range(5)]
+        manager.batch_push(msgs)
+        out = manager.batch_pop("normal", 3)
+        assert len(out) == 3
+        assert manager.queue.size("normal") == 2
+
+    def test_drain_in_priority_order(self, manager):
+        # The strict-priority drain of cmd/queue-manager/main.go:112-124.
+        manager.push_message(Message(content="low", priority=Priority.LOW))
+        manager.push_message(Message(content="rt", priority=Priority.REALTIME))
+        manager.push_message(Message(content="hi", priority=Priority.HIGH))
+        out = manager.drain_in_priority_order(10)
+        assert [m.content for m in out] == ["rt", "hi", "low"]
+
+
+class TestAccounting:
+    def test_complete_uses_tracked_queue(self, manager):
+        m = Message(priority=Priority.HIGH)
+        manager.push_message(m)
+        popped = manager.pop_message("high")
+        manager.complete_message(popped, process_time=0.5)
+        s = manager.get_stats("high")
+        assert s.completed_count == 1 and s.processing_count == 0
+
+    def test_fail(self, manager):
+        m = Message(priority=Priority.LOW)
+        manager.push_message(m)
+        manager.pop_message("low")
+        manager.fail_message(m)
+        assert manager.get_stats("low").failed_count == 1
+
+    def test_requeue_message(self, manager):
+        m = Message()
+        manager.push_message(m)
+        manager.pop_message("normal")
+        manager.requeue_message(m)
+        s = manager.get_stats("normal")
+        assert s.pending_count == 1 and s.processing_count == 0
+
+
+class TestMonitor:
+    def test_scale_up_signal(self, fake_clock, queue_backend):
+        signals = []
+        cfg = default_config()
+        cfg.scheduler.scale_up_threshold = 3
+        cfg.scheduler.scale_down_threshold = 0
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False,
+                          scale_callback=signals.append)
+        for _ in range(4):
+            qm.push_message(Message())
+        sig = qm.run_monitor_once()
+        assert sig is not None and sig.direction == "up"
+        assert signals and signals[0].total_pending == 4
+
+    def test_scale_down_signal(self, fake_clock, queue_backend):
+        cfg = default_config()
+        cfg.scheduler.scale_down_threshold = 10
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False)
+        sig = qm.run_monitor_once()
+        assert sig is not None and sig.direction == "down"
+
+    def test_stale_cleanup_real(self, fake_clock, queue_backend):
+        # Real version of the reference's stub (queue_manager.go:549-553).
+        cfg = default_config()
+        cfg.queue.stale_message_age = 60.0
+        cfg.scheduler.scale_down_threshold = -1  # no signal noise
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False)
+        stale = Message(content="stale")
+        qm.push_message(stale)
+        fake_clock.advance(120.0)
+        fresh = Message(content="fresh")
+        qm.push_message(fresh)
+        qm.run_monitor_once()
+        assert qm.queue.size("normal") == 1
+        assert qm.pop_message("normal").content == "fresh"
