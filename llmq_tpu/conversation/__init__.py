@@ -1,0 +1,7 @@
+from llmq_tpu.conversation.state_manager import StateManager  # noqa: F401
+from llmq_tpu.conversation.persistence import (  # noqa: F401
+    ConversationStore,
+    InMemoryStore,
+    SqliteStore,
+    make_store,
+)

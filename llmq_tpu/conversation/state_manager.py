@@ -1,0 +1,328 @@
+"""Unified conversation state manager.
+
+The reference maintains THREE overlapping conversation managers —
+in-memory + pluggable store (internal/conversation/state_manager.go),
+a GORM+redis-v8 write-through manager (internal/statemanager/manager.go),
+and per-binary wiring divergence (SURVEY.md #13-#15). This is the single
+replacement, with the union of their behavior:
+
+- get-or-create with store fallback (state_manager.go:72-114)
+- ``add_message`` appends, trims the context window, persists
+  (state_manager.go:117-147; window :131-134)
+- completed responses appended to ``Conversation.context``
+  (manager.go:116-138)
+- per-user conversation cap archives the oldest (state_manager.go:327-350)
+- cleanup loop expires by TTL / idle time / completed+24h
+  (state_manager.go:354-403) — driven by an injectable clock here
+- user/active queries (manager.go:140-199)
+
+KV-cache pinning hooks (new scope; BASELINE config #3): the executor
+registers ``on_touch``/``on_evict`` callbacks so a conversation's paged
+KV cache is pinned in TPU HBM while the conversation is live and released
+exactly when the conversation expires here — one eviction policy for both
+host state and HBM state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.config import ConversationConfig
+from llmq_tpu.core.errors import ConversationNotFoundError
+from llmq_tpu.core.types import (
+    Conversation,
+    ConversationState,
+    Message,
+)
+from llmq_tpu.conversation.persistence import ConversationStore, InMemoryStore
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("conversation")
+
+_COMPLETED_LINGER = 24 * 3600.0  # completed conversations kept 24h (:354-403)
+
+
+class StateManager:
+    def __init__(
+        self,
+        config: Optional[ConversationConfig] = None,
+        store: Optional[ConversationStore] = None,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.config = config or ConversationConfig()
+        self._store = store if store is not None else InMemoryStore()
+        self._persist = self.config.persist and store is not None
+        self._clock = clock or SYSTEM_CLOCK
+        self._convs: Dict[str, Conversation] = {}
+        self._user_convs: Dict[str, List[str]] = {}
+        self._mu = threading.RLock()
+        self._on_touch: List[Callable[[Conversation], None]] = []
+        self._on_evict: List[Callable[[Conversation], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- KV pinning hooks ----------------------------------------------------
+
+    def on_touch(self, cb: Callable[[Conversation], None]) -> None:
+        self._on_touch.append(cb)
+
+    def on_evict(self, cb: Callable[[Conversation], None]) -> None:
+        self._on_evict.append(cb)
+
+    def _fire(self, cbs: List[Callable[[Conversation], None]],
+              conv: Conversation) -> None:
+        for cb in cbs:
+            try:
+                cb(conv)
+            except Exception:  # noqa: BLE001
+                log.exception("conversation hook failed for %s", conv.id)
+
+    # -- core API ------------------------------------------------------------
+
+    def get_or_create(self, conversation_id: str, user_id: str = "") -> Conversation:
+        """get-or-create, falling back to the store (:72-114)."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is not None:
+                conv.last_active_at = self._clock.now()
+                self._fire(self._on_touch, conv)
+                return conv
+        loaded: Optional[Conversation] = None
+        if self._persist:
+            try:
+                loaded = self._store.load(conversation_id)
+            except Exception:  # noqa: BLE001
+                log.exception("store load failed for %s", conversation_id)
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                conv = loaded or Conversation(
+                    id=conversation_id, user_id=user_id,
+                    created_at=self._clock.now(),
+                    updated_at=self._clock.now(),
+                    last_active_at=self._clock.now())
+                self._admit_locked(conv)
+            conv.last_active_at = self._clock.now()
+        self._fire(self._on_touch, conv)
+        return conv
+
+    def get(self, conversation_id: str) -> Conversation:
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+        if conv is None and self._persist:
+            conv = self._store.load(conversation_id)
+            if conv is not None:
+                with self._mu:
+                    self._admit_locked(conv)
+        if conv is None:
+            raise ConversationNotFoundError(conversation_id)
+        return conv
+
+    def create(self, user_id: str, conversation_id: Optional[str] = None,
+               metadata: Optional[Dict] = None) -> Conversation:
+        conv = Conversation(
+            user_id=user_id, created_at=self._clock.now(),
+            updated_at=self._clock.now(), last_active_at=self._clock.now(),
+            metadata=metadata or {})
+        if conversation_id:
+            conv.id = conversation_id
+        with self._mu:
+            self._admit_locked(conv)
+        self._save(conv)
+        return conv
+
+    def _admit_locked(self, conv: Conversation) -> None:
+        self._convs[conv.id] = conv
+        lst = self._user_convs.setdefault(conv.user_id, [])
+        if conv.id not in lst:
+            lst.append(conv.id)
+        # Per-user cap: archive the oldest (:327-350).
+        cap = self.config.max_conversations_per_user
+        while cap > 0 and len(lst) > cap:
+            oldest_id = min(
+                (cid for cid in lst if cid in self._convs),
+                key=lambda cid: self._convs[cid].last_active_at,
+                default=None)
+            if oldest_id is None or oldest_id == conv.id:
+                break
+            self._evict_locked(self._convs[oldest_id], archive=True)
+        # Global cap.
+        gcap = self.config.max_conversations
+        while gcap > 0 and len(self._convs) > gcap:
+            oldest = min(self._convs.values(), key=lambda c: c.last_active_at)
+            if oldest.id == conv.id:
+                break
+            self._evict_locked(oldest, archive=True)
+
+    def add_message(self, conversation_id: str, message: Message,
+                    user_id: str = "") -> Conversation:
+        """Append + context-window trim + persist (:117-147)."""
+        conv = self.get_or_create(conversation_id, user_id or message.user_id)
+        with self._mu:
+            message.conversation_id = conversation_id
+            conv.messages.append(message)
+            self._trim_window_locked(conv)
+            now = self._clock.now()
+            conv.updated_at = now
+            conv.last_active_at = now
+        self._save(conv)
+        return conv
+
+    def record_response(self, conversation_id: str, message: Message) -> None:
+        """Fold a completed message's response into the running context
+        string (manager.go:116-138)."""
+        conv = self.get_or_create(conversation_id, message.user_id)
+        with self._mu:
+            if message.response:
+                sep = "\n" if conv.context else ""
+                conv.context += f"{sep}{message.response}"
+                if len(conv.context) > self.config.max_context_length:
+                    conv.context = conv.context[-self.config.max_context_length:]
+            conv.updated_at = self._clock.now()
+        self._save(conv)
+
+    def _trim_window_locked(self, conv: Conversation) -> None:
+        """Sliding window: keep the most recent messages whose cumulative
+        content length fits max_context_length (state_manager.go:131-134
+        trims by count; characters are the natural unit when the window
+        feeds a tokenizer)."""
+        budget = self.config.max_context_length
+        if budget <= 0:
+            return
+        total = 0
+        keep_from = len(conv.messages)
+        for i in range(len(conv.messages) - 1, -1, -1):
+            total += len(conv.messages[i].content)
+            if total > budget and keep_from < len(conv.messages):
+                break
+            keep_from = i
+        if keep_from > 0:
+            conv.messages = conv.messages[keep_from:]
+
+    def update_state(self, conversation_id: str,
+                     state: ConversationState) -> Conversation:
+        conv = self.get(conversation_id)
+        with self._mu:
+            conv.state = ConversationState(state)
+            conv.updated_at = self._clock.now()
+        self._save(conv)
+        return conv
+
+    def delete(self, conversation_id: str) -> bool:
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is not None:
+                self._evict_locked(conv, archive=False)
+        if self._persist:
+            try:
+                self._store.delete(conversation_id)
+            except Exception:  # noqa: BLE001
+                log.exception("store delete failed for %s", conversation_id)
+        return conv is not None
+
+    # -- queries (manager.go:140-199) ----------------------------------------
+
+    def user_conversations(self, user_id: str) -> List[Conversation]:
+        with self._mu:
+            local = [self._convs[cid]
+                     for cid in self._user_convs.get(user_id, [])
+                     if cid in self._convs]
+        if self._persist:
+            try:
+                for cid in self._store.list_user(user_id):
+                    if all(c.id != cid for c in local):
+                        loaded = self._store.load(cid)
+                        if loaded is not None:
+                            local.append(loaded)
+            except Exception:  # noqa: BLE001
+                log.exception("store list_user failed for %s", user_id)
+        return sorted(local, key=lambda c: c.last_active_at, reverse=True)
+
+    def active_conversations(self) -> List[Conversation]:
+        with self._mu:
+            return [c for c in self._convs.values()
+                    if c.state == ConversationState.ACTIVE]
+
+    def count(self) -> int:
+        with self._mu:
+            return len(self._convs)
+
+    # -- cleanup (:354-403) --------------------------------------------------
+
+    def run_cleanup_once(self) -> int:
+        now = self._clock.now()
+        evicted = 0
+        with self._mu:
+            for conv in list(self._convs.values()):
+                expired = (
+                    (self.config.ttl > 0
+                     and now - conv.created_at > self.config.ttl)
+                    or (self.config.max_idle_time > 0
+                        and now - conv.last_active_at > self.config.max_idle_time)
+                    or (conv.state == ConversationState.COMPLETED
+                        and now - conv.updated_at > _COMPLETED_LINGER))
+                if expired:
+                    if conv.state == ConversationState.ACTIVE:
+                        conv.state = ConversationState.EXPIRED
+                    self._evict_locked(conv, archive=True)
+                    evicted += 1
+        if evicted:
+            log.info("cleanup evicted %d conversations", evicted)
+        return evicted
+
+    def _evict_locked(self, conv: Conversation, archive: bool) -> None:
+        """Remove from memory (persisting first if configured) and fire
+        KV-unpin hooks."""
+        if archive:
+            self._save(conv)
+        self._convs.pop(conv.id, None)
+        lst = self._user_convs.get(conv.user_id)
+        if lst and conv.id in lst:
+            lst.remove(conv.id)
+            if not lst:
+                self._user_convs.pop(conv.user_id, None)
+        self._fire(self._on_evict, conv)
+
+    def _save(self, conv: Conversation) -> None:
+        if not self._persist:
+            return
+        try:
+            self._store.save(conv)
+        except Exception:  # noqa: BLE001
+            log.exception("store save failed for %s", conv.id)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None or self.config.cleanup_interval <= 0:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._cleanup_loop, name="conv-cleanup", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _cleanup_loop(self) -> None:
+        while not self._stop.wait(self.config.cleanup_interval):
+            try:
+                self.run_cleanup_once()
+            except Exception:  # noqa: BLE001
+                log.exception("conversation cleanup failed")
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            states: Dict[str, int] = {}
+            for c in self._convs.values():
+                states[c.state.value] = states.get(c.state.value, 0) + 1
+            return {
+                "conversations": len(self._convs),
+                "users": len(self._user_convs),
+                "by_state": states,
+            }

@@ -295,6 +295,11 @@ def _apply_env(cfg: Config, environ: Optional[Dict[str, str]] = None) -> None:
                     if j == len(parts):
                         cur = getattr(obj, cand)
                         setattr(obj, cand, _coerce(raw, cur))
+                        # Re-validate, mirroring _merge (an env var must not
+                        # sneak in a strategy name YAML would reject).
+                        post = getattr(obj, "__post_init__", None)
+                        if post is not None:
+                            post()
                         i = j
                     else:
                         obj = getattr(obj, cand)

@@ -1,0 +1,5 @@
+from llmq_tpu.loadbalancer.load_balancer import (  # noqa: F401
+    Endpoint,
+    EndpointStatus,
+    LoadBalancer,
+)

@@ -1,0 +1,4 @@
+from llmq_tpu.preprocessor.preprocessor import (  # noqa: F401
+    Preprocessor,
+    analyze_message_content,
+)

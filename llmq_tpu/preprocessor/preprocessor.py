@@ -1,0 +1,145 @@
+"""Message preprocessor: content analysis → priority assignment.
+
+Parity with reference ``internal/preprocessor/preprocessor.go``:
+
+Priority inference order (preprocessor.go:56-114):
+
+1. explicit non-default priority is respected (:63-65)
+2. ``metadata["user_priority"]`` override (:68-82)
+3. per-user default priority table, set via ``set_user_priority``
+   (:83-86, :171-173)
+4. keyword scoring: realtime = {immediate, emergency, asap, right now},
+   high = {urgent, important, priority, critical, soon}; case-insensitive,
+   the tier with the most hits wins (:28-29, :117-168)
+
+Content annotation (performContentAnalysis, :197-249): word count, naive
+lexicon sentiment, question detection, ``analyzed`` marker.
+``analyze_message_content`` standalone variant (:253-299).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("preprocessor")
+
+# Keyword tiers (reference preprocessor.go:28-29).
+REALTIME_KEYWORDS = ("immediate", "emergency", "asap", "right now")
+HIGH_KEYWORDS = ("urgent", "important", "priority", "critical", "soon")
+
+_POSITIVE_WORDS = frozenset(
+    "good great excellent amazing wonderful fantastic love happy thanks "
+    "thank perfect best awesome nice helpful".split())
+_NEGATIVE_WORDS = frozenset(
+    "bad terrible awful horrible hate angry wrong broken fail failed "
+    "error problem worst useless annoying".split())
+
+_QUESTION_WORDS = ("what", "why", "how", "when", "where", "who", "which",
+                   "can", "could", "would", "should", "is", "are", "do",
+                   "does", "did")
+
+
+def _compile(words: Tuple[str, ...]) -> List[re.Pattern]:
+    return [re.compile(r"\b" + re.escape(w).replace(r"\ ", r"\s+") + r"\b",
+                       re.IGNORECASE) for w in words]
+
+
+_REALTIME_PATTERNS = _compile(REALTIME_KEYWORDS)
+_HIGH_PATTERNS = _compile(HIGH_KEYWORDS)
+
+
+class Preprocessor:
+    def __init__(self, enable_content_analysis: bool = True) -> None:
+        self.enable_content_analysis = enable_content_analysis
+        self._user_priorities: Dict[str, Priority] = {}
+        self._mu = threading.RLock()
+
+    # -- user defaults (preprocessor.go:171-173) ----------------------------
+
+    def set_user_priority(self, user_id: str, priority: Priority) -> None:
+        with self._mu:
+            self._user_priorities[user_id] = Priority.parse(priority)
+
+    def remove_user_priority(self, user_id: str) -> bool:
+        with self._mu:
+            return self._user_priorities.pop(user_id, None) is not None
+
+    def get_user_priorities(self) -> Dict[str, Priority]:
+        with self._mu:
+            return dict(self._user_priorities)
+
+    # -- main pipeline (preprocessor.go:56-114) ------------------------------
+
+    def process_message(self, message: Message) -> Message:
+        message.priority = self._infer_priority(message)
+        if self.enable_content_analysis:
+            self._annotate(message)
+        message.metadata["analyzed"] = True
+        return message
+
+    def _infer_priority(self, message: Message) -> Priority:
+        # 1. explicit non-default priority wins (:63-65)
+        if message.priority != Priority.NORMAL:
+            return message.priority
+        # 2. metadata override (:68-82)
+        override = message.metadata.get("user_priority")
+        if override is not None:
+            try:
+                return Priority.parse(override)
+            except (ValueError, TypeError):
+                log.warning("invalid user_priority metadata %r on message %s",
+                            override, message.id)
+        # 3. per-user default (:83-86)
+        with self._mu:
+            user_default = self._user_priorities.get(message.user_id)
+        if user_default is not None:
+            return user_default
+        # 4. keyword scoring (:117-168)
+        return self._analyze_priority(message.content)
+
+    @staticmethod
+    def _analyze_priority(content: str) -> Priority:
+        rt_hits = sum(1 for p in _REALTIME_PATTERNS if p.search(content))
+        hi_hits = sum(1 for p in _HIGH_PATTERNS if p.search(content))
+        if rt_hits == 0 and hi_hits == 0:
+            return Priority.NORMAL
+        return Priority.REALTIME if rt_hits >= hi_hits else Priority.HIGH
+
+    # -- content annotation (:197-249) ---------------------------------------
+
+    def _annotate(self, message: Message) -> None:
+        message.metadata.update(analyze_text(message.content))
+
+
+def analyze_text(content: str) -> Dict[str, Any]:
+    words = re.findall(r"[\w']+", content.lower())
+    pos = sum(1 for w in words if w in _POSITIVE_WORDS)
+    neg = sum(1 for w in words if w in _NEGATIVE_WORDS)
+    sentiment = "neutral"
+    if pos > neg:
+        sentiment = "positive"
+    elif neg > pos:
+        sentiment = "negative"
+    stripped = content.strip()
+    is_question = stripped.endswith("?") or (
+        bool(words) and words[0] in _QUESTION_WORDS)
+    return {
+        "word_count": len(words),
+        "char_count": len(content),
+        "sentiment": sentiment,
+        "is_question": is_question,
+    }
+
+
+def analyze_message_content(message: Message) -> Dict[str, Any]:
+    """Standalone analysis (AnalyzeMessageContent, preprocessor.go:253-299):
+    returns the analysis dict without mutating the message."""
+    analysis = analyze_text(message.content)
+    analysis["suggested_priority"] = int(
+        Preprocessor._analyze_priority(message.content))
+    return analysis

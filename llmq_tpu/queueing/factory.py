@@ -96,33 +96,35 @@ class QueueFactory:
         """Create (or return the existing) named manager, fully wired with
         its delayed queue and DLQ."""
         qtype = QueueType(qtype)
+        # Entire create is under the registry lock: a concurrent create for
+        # the same name must not build (and leak the background threads of)
+        # a second manager.
         with self._lock:
             entry = self._entries.get(name)
             if entry is not None:
                 return entry.manager
-        manager = QueueManager(
-            name, config=self.config, clock=self._clock, backend=self._backend,
-            enable_metrics=enable_metrics)
-        dlq: Optional[DeadLetterQueue] = None
-        if self.config.queue.dead_letter_enabled or qtype == QueueType.DEAD_LETTER:
-            dlq = DeadLetterQueue(
-                max_size=self.config.queue.dead_letter_max_size,
-                clock=self._clock, name=f"{name}-dlq")
-        # Undeliverable retries (target queue persistently full/missing)
-        # land in the DLQ instead of being dropped.
-        on_drop = (
-            (lambda qname, msg, reason: dlq.push(msg, f"undeliverable: {reason}", qname))
-            if dlq is not None else None)
-        delayed = DelayedQueue(
-            deliver=lambda qname, msg: manager.push_message(msg, qname or None),
-            clock=self._clock, name=f"{name}-delayed", on_drop=on_drop)
-        if qtype == QueueType.PRIORITY:
-            manager.add_priority_rule(vip_rule())
-            manager.add_priority_rule(long_content_rule())
-        if start_background:
-            delayed.start()
-            manager.start(monitor_interval=self.config.scheduler.monitor_interval)
-        with self._lock:
+            manager = QueueManager(
+                name, config=self.config, clock=self._clock, backend=self._backend,
+                enable_metrics=enable_metrics)
+            dlq: Optional[DeadLetterQueue] = None
+            if self.config.queue.dead_letter_enabled or qtype == QueueType.DEAD_LETTER:
+                dlq = DeadLetterQueue(
+                    max_size=self.config.queue.dead_letter_max_size,
+                    clock=self._clock, name=f"{name}-dlq")
+            # Undeliverable retries (target queue persistently full/missing)
+            # land in the DLQ instead of being dropped.
+            on_drop = (
+                (lambda qname, msg, reason: dlq.push(msg, f"undeliverable: {reason}", qname))
+                if dlq is not None else None)
+            delayed = DelayedQueue(
+                deliver=lambda qname, msg: manager.push_message(msg, qname or None),
+                clock=self._clock, name=f"{name}-delayed", on_drop=on_drop)
+            if qtype == QueueType.PRIORITY:
+                manager.add_priority_rule(vip_rule())
+                manager.add_priority_rule(long_content_rule())
+            if start_background:
+                delayed.start()
+                manager.start(monitor_interval=self.config.scheduler.monitor_interval)
             self._entries[name] = _Entry(manager, delayed, dlq, [], qtype)
         log.info("created queue manager %s (type=%s)", name, qtype.value)
         return manager

@@ -239,12 +239,15 @@ class MultiLevelQueue:
     def push(self, name: str, message: Message) -> None:
         now = self._clock.now()
         handle = next(self._next_handle)
+        # Status is set BEFORE the message becomes visible to concurrent
+        # poppers — a pop may legitimately complete the message before this
+        # function returns, and must not be overwritten back to PENDING.
+        message.status = MessageStatus.PENDING
+        message.touch(now)
         with self._mu:
             self._messages[handle] = (name, message, now)
         err = self._core.push(name, handle, int(message.priority), now)
         if err == 0:
-            message.status = MessageStatus.PENDING
-            message.touch(now)
             return
         with self._mu:
             self._messages.pop(handle, None)

@@ -147,6 +147,18 @@ class Worker:
         self.rconfig = retry_config or manager.config.queue.retry
         self._clock = clock or SYSTEM_CLOCK
         self.backoff = backoff or self._backoff_from_config()
+        if delayed_queue is None:
+            # A worker ALWAYS has a delayed queue so retry backoff is real
+            # (without one, scheduled_at would be set but nothing would
+            # honor it and retries would burn instantly). An owned queue is
+            # started/stopped with the worker and additionally ticked from
+            # process_batch so synchronous (loop-less) usage works too.
+            delayed_queue = DelayedQueue(
+                deliver=lambda qname, msg: manager.push_message(msg, qname or None),
+                clock=clock or SYSTEM_CLOCK, name=f"{name}-retries")
+            self._owned_delayed = True
+        else:
+            self._owned_delayed = False
         self.delayed_queue = delayed_queue
         self.dead_letter_queue = dead_letter_queue
         self.stats = WorkerStats()
@@ -167,6 +179,8 @@ class Worker:
         if self._thread is not None:
             return
         self._stop.clear()
+        if self._owned_delayed:
+            self.delayed_queue.start()
         self._pool = ThreadPoolExecutor(
             max_workers=self.wconfig.max_concurrent,
             thread_name_prefix=f"worker-{self.name}")
@@ -179,9 +193,11 @@ class Worker:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
-        if self._pool is not None:
-            self._pool.shutdown(wait=wait)
-            self._pool = None
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=wait)
+        if self._owned_delayed:
+            self.delayed_queue.stop()
 
     @property
     def running(self) -> bool:
@@ -200,11 +216,21 @@ class Worker:
         """Pop up to max_batch_size in priority order and dispatch.
         Returns the number of messages dispatched. Callable directly from
         tests (no loop needed)."""
+        if self._owned_delayed and self._thread is None:
+            # Synchronous mode: tick retry deliveries ourselves.
+            self.delayed_queue.run_due_once()
         batch = self.manager.drain_in_priority_order(self.wconfig.max_batch_size)
         for msg in batch:
             self._sem.acquire()
-            if self._pool is not None:
-                self._pool.submit(self._run_one, msg)
+            pool = self._pool
+            if pool is not None:
+                try:
+                    pool.submit(self._run_one, msg)
+                except RuntimeError:
+                    # Pool shut down between the check and the submit (a
+                    # stop() race): process inline so an already-popped
+                    # message is never abandoned in PROCESSING.
+                    self._run_one(msg)
             else:  # synchronous mode (tests, echo bench)
                 self._run_one(msg)
         return len(batch)
@@ -254,15 +280,11 @@ class Worker:
             delay = self.backoff.next_backoff(msg.retry_count)
             with self.stats._mu:
                 self.stats.retried += 1
-            if self.delayed_queue is not None:
-                # Proper wiring: requeue accounting now, delivery after the
-                # backoff delay (fixes worker.go:227-229's immediate re-push).
-                qname = self.manager.stash_for_retry(msg)
-                msg.status = MessageStatus.PENDING
-                self.delayed_queue.schedule_after(msg, delay, qname)
-            else:
-                msg.scheduled_at = self._clock.now() + delay
-                qname = self.manager.requeue_message(msg)
+            # Proper wiring: requeue accounting now, delivery after the
+            # backoff delay (fixes worker.go:227-229's immediate re-push).
+            qname = self.manager.stash_for_retry(msg)
+            msg.status = MessageStatus.PENDING
+            self.delayed_queue.schedule_after(msg, delay, qname)
             log.info("message %s retry %d/%d in %.2fs (%s)",
                      msg.id, msg.retry_count, msg.max_retries, delay, reason)
             return

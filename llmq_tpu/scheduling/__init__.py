@@ -1,0 +1,10 @@
+from llmq_tpu.scheduling.topology import TpuTopology, ChipInfo  # noqa: F401
+from llmq_tpu.scheduling.resource_scheduler import (  # noqa: F401
+    Resource,
+    ResourceAllocation,
+    ResourceRequest,
+    ResourceScheduler,
+    ResourceStatus,
+    ResourceType,
+)
+from llmq_tpu.scheduling.autoscaler import Autoscaler, ScalingStrategy  # noqa: F401

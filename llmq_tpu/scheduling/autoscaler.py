@@ -1,0 +1,189 @@
+"""Endpoint autoscaler.
+
+Parity with reference ``internal/scheduler/scheduler.go``:
+
+- ``ScalingStrategy`` ∈ static/dynamic/adaptive/hybrid (scheduler.go:18-27)
+- monitor loop every ``monitor_interval`` (:59-81)
+- ``dynamic``: scale endpoint count on total pending vs thresholds within
+  [min, max] (:119-181)
+- ``adaptive``: time-of-day heuristic — business hours Mon–Fri 9–17 run
+  near max endpoints (:184-254)
+- ``hybrid``: dynamic + response-time-based weight adjustment (:257-296)
+
+Fixes over the reference:
+
+- scaling ACTS: provision/decommission callbacks add/remove real
+  endpoints from the LoadBalancer (the reference logs "would switch…"
+  and fabricates ``http://llm-processor-N:8080`` URLs, :168-180, :299-301)
+- hybrid weight suggestions are applied to endpoint weights, not logged
+
+In the TPU build "provisioning an endpoint" typically means activating
+another engine replica / sub-slice (the provision callback decides);
+within a fixed slice the autoscaler can instead adjust worker/batch knobs
+(SURVEY.md §7 stage 9).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, List, Optional
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.config import SchedulerConfig
+from llmq_tpu.loadbalancer.load_balancer import Endpoint, LoadBalancer
+from llmq_tpu.queueing.queue_manager import QueueManager
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("autoscaler")
+
+#: provision() returns a new Endpoint to add; decommission(endpoint) tears
+#: one down. Both are supplied by the deployment (engine pool, k8s, …).
+ProvisionFn = Callable[[int], Optional[Endpoint]]
+DecommissionFn = Callable[[Endpoint], None]
+
+
+class ScalingStrategy(str, enum.Enum):
+    STATIC = "static"
+    DYNAMIC = "dynamic"
+    ADAPTIVE = "adaptive"
+    HYBRID = "hybrid"
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        queue_manager: QueueManager,
+        load_balancer: LoadBalancer,
+        config: Optional[SchedulerConfig] = None,
+        provision_fn: Optional[ProvisionFn] = None,
+        decommission_fn: Optional[DecommissionFn] = None,
+        clock: Optional[Clock] = None,
+        localtime_fn: Callable[[], time.struct_time] = time.localtime,
+    ) -> None:
+        self.queue_manager = queue_manager
+        self.load_balancer = load_balancer
+        self.config = config or SchedulerConfig()
+        self.strategy = ScalingStrategy(self.config.strategy)
+        self._provision = provision_fn
+        self._decommission = decommission_fn
+        self._clock = clock or SYSTEM_CLOCK
+        self._localtime = localtime_fn
+        self._last_scale_at = 0.0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one tick (testable without sleeping) --------------------------------
+
+    def run_once(self) -> dict:
+        total_pending = self.queue_manager.total_pending()
+        n_endpoints = len(self.load_balancer.endpoints())
+        action = "none"
+        if self.strategy == ScalingStrategy.STATIC:
+            pass
+        elif self.strategy == ScalingStrategy.DYNAMIC:
+            action = self._dynamic(total_pending, n_endpoints)
+        elif self.strategy == ScalingStrategy.ADAPTIVE:
+            action = self._adaptive(n_endpoints)
+        elif self.strategy == ScalingStrategy.HYBRID:
+            action = self._dynamic(total_pending, n_endpoints)
+            self._rebalance_weights()
+        return {"pending": total_pending, "endpoints": n_endpoints,
+                "action": action}
+
+    # -- strategies ----------------------------------------------------------
+
+    def _dynamic(self, pending: int, n: int) -> str:
+        """scheduler.go:119-181, acting for real."""
+        now = self._clock.now()
+        if now - self._last_scale_at < self.config.cooldown:
+            return "cooldown"
+        if pending >= self.config.scale_up_threshold and n < self.config.max_endpoints:
+            return self._scale_to(n + 1, f"pending={pending}")
+        if pending <= self.config.scale_down_threshold and n > self.config.min_endpoints:
+            return self._scale_to(n - 1, f"pending={pending}")
+        return "none"
+
+    def _adaptive(self, n: int) -> str:
+        """Business-hours heuristic (scheduler.go:184-254)."""
+        now = self._clock.now()
+        if now - self._last_scale_at < self.config.cooldown:
+            return "cooldown"
+        lt = self._localtime()
+        business = lt.tm_wday < 5 and 9 <= lt.tm_hour < 17
+        target = (max(self.config.max_endpoints - 1, self.config.min_endpoints)
+                  if business else self.config.min_endpoints)
+        if target == n:
+            return "none"
+        return self._scale_to(min(max(target, self.config.min_endpoints),
+                                  self.config.max_endpoints),
+                              f"{'business' if business else 'off'}-hours")
+
+    def _scale_to(self, target: int, reason: str) -> str:
+        current = self.load_balancer.endpoints()
+        n = len(current)
+        if target > n:
+            if self._provision is None:
+                log.warning("scale up wanted (%s) but no provision_fn", reason)
+                return "none"
+            for _ in range(target - n):
+                self._seq += 1
+                ep = self._provision(self._seq)
+                if ep is None:
+                    break
+                self.load_balancer.add_endpoint(ep)
+            self._last_scale_at = self._clock.now()
+            log.info("scaled up to %d endpoints (%s)",
+                     len(self.load_balancer.endpoints()), reason)
+            return "up"
+        if target < n:
+            # Drop the least-busy endpoints first.
+            removed = 0
+            for ep in sorted(current, key=lambda e: e.connections)[:n - target]:
+                if self._decommission is not None:
+                    try:
+                        self._decommission(ep)
+                    except Exception:  # noqa: BLE001
+                        log.exception("decommission of %s failed", ep.id)
+                self.load_balancer.remove_endpoint(ep.id)
+                removed += 1
+            self._last_scale_at = self._clock.now()
+            log.info("scaled down by %d endpoints (%s)", removed, reason)
+            return "down"
+        return "none"
+
+    def _rebalance_weights(self) -> None:
+        """Hybrid extra: weight ∝ 1/response_time, APPLIED (the reference
+        only logs suggestions, scheduler.go:257-296)."""
+        eps = self.load_balancer.endpoints()
+        with_rt = [e for e in eps if e.response_time > 0]
+        if len(with_rt) < 2:
+            return
+        min_rt = min(e.response_time for e in with_rt)
+        for e in with_rt:
+            e.weight = round(max(0.1, min_rt / e.response_time), 3)
+
+    # -- loop ----------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.monitor_interval):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001
+                log.exception("autoscaler tick failed")

@@ -1,0 +1,449 @@
+"""LLM resource allocator, generalised to TPU chips/HBM.
+
+Parity with reference ``internal/scheduler/resource_scheduler.go``:
+
+- ``Resource`` {model type, capabilities, per-type capacity/used, load,
+  endpoint, heartbeat} (resource_scheduler.go:17-47); ``ResourceType``
+  generalised from {cpu, gpu, memory, tokens} (:17-22) to include
+  ``CHIP``/``HBM_GB`` (BASELINE: "chips/HBM instead of cpu,gpu,memory,tokens")
+- ``request_resource`` → ``try_allocate``: filter by status, model type,
+  capabilities, capacity; pick lowest load; allocation with expiry +
+  token (:202-235, :336-398); otherwise priority-sorted pending queue
+  (:213-232)
+- background monitor: heartbeat timeout → offline (:477-492), allocation
+  expiry reclaim (:495-522), autoscale thresholds + cooldown (:525-571)
+- pending-request processor (:418-474)
+
+Fixes over the reference:
+
+- ``trigger_scale_up/down`` call REAL registered actuators (stubs at
+  :574-595)
+- pending-timeout uses ``request.created_at`` — the reference reads
+  ``metadata["queuedAt"]`` which is never written and panics when a
+  timeout is set (:454; SURVEY.md #12 "Known bug")
+- ``release`` recomputes load from used/capacity (the reference just
+  halves it, :691-695)
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.config import ResourceSchedulerConfig
+from llmq_tpu.core.errors import AllocationNotFoundError, NoResourceError
+from llmq_tpu.core.types import Priority
+from llmq_tpu.scheduling.topology import TpuTopology
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("resource_scheduler")
+
+
+class ResourceType(str, enum.Enum):
+    # Reference types (resource_scheduler.go:17-22):
+    CPU = "cpu"
+    GPU = "gpu"
+    MEMORY = "memory"
+    TOKENS = "tokens"
+    # TPU generalisation:
+    CHIP = "chip"
+    HBM_GB = "hbm_gb"
+    TOKENS_PER_S = "tokens_per_s"
+
+
+class ResourceStatus(str, enum.Enum):
+    ONLINE = "online"
+    BUSY = "busy"
+    OFFLINE = "offline"
+
+
+@dataclass
+class Resource:
+    id: str
+    model_type: str = "llm"
+    capabilities: Set[str] = field(default_factory=set)
+    capacity: Dict[ResourceType, float] = field(default_factory=dict)
+    used: Dict[ResourceType, float] = field(default_factory=dict)
+    endpoint: str = ""
+    status: ResourceStatus = ResourceStatus.ONLINE
+    last_heartbeat: float = 0.0
+    metadata: Dict = field(default_factory=dict)
+
+    @property
+    def load(self) -> float:
+        """Mean used/capacity over resource types (:660-688)."""
+        if not self.capacity:
+            return 0.0
+        fracs = [
+            self.used.get(t, 0.0) / cap
+            for t, cap in self.capacity.items() if cap > 0
+        ]
+        return sum(fracs) / len(fracs) if fracs else 0.0
+
+    def available(self, rtype: ResourceType) -> float:
+        return self.capacity.get(rtype, 0.0) - self.used.get(rtype, 0.0)
+
+    def to_dict(self) -> Dict:
+        return {
+            "id": self.id,
+            "model_type": self.model_type,
+            "capabilities": sorted(self.capabilities),
+            "capacity": {t.value: v for t, v in self.capacity.items()},
+            "used": {t.value: v for t, v in self.used.items()},
+            "load": self.load,
+            "endpoint": self.endpoint,
+            "status": self.status.value,
+            "last_heartbeat": self.last_heartbeat,
+        }
+
+
+@dataclass
+class ResourceRequest:
+    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    model_type: str = "llm"
+    capabilities: Set[str] = field(default_factory=set)
+    amounts: Dict[ResourceType, float] = field(default_factory=dict)
+    priority: Priority = Priority.NORMAL
+    timeout: float = 0.0          # 0 = wait forever in pending
+    created_at: float = 0.0
+    metadata: Dict = field(default_factory=dict)
+
+
+@dataclass
+class ResourceAllocation:
+    id: str
+    resource_id: str
+    request: ResourceRequest
+    token: str
+    allocated_at: float
+    expires_at: float             # 0 = no expiry
+
+    def to_dict(self) -> Dict:
+        return {
+            "id": self.id,
+            "resource_id": self.resource_id,
+            "request_id": self.request.id,
+            "allocated_at": self.allocated_at,
+            "expires_at": self.expires_at,
+        }
+
+
+ScaleFn = Callable[[str], None]  # receives a human-readable reason
+
+
+class ResourceScheduler:
+    def __init__(
+        self,
+        config: Optional[ResourceSchedulerConfig] = None,
+        clock: Optional[Clock] = None,
+        topology: Optional[TpuTopology] = None,
+        scale_up_fn: Optional[ScaleFn] = None,
+        scale_down_fn: Optional[ScaleFn] = None,
+    ) -> None:
+        self.config = config or ResourceSchedulerConfig()
+        self._clock = clock or SYSTEM_CLOCK
+        self.topology = topology
+        self._scale_up_fn = scale_up_fn
+        self._scale_down_fn = scale_down_fn
+        self._resources: Dict[str, Resource] = {}
+        self._allocations: Dict[str, ResourceAllocation] = {}
+        self._pending: List[ResourceRequest] = []  # kept priority-sorted
+        self._waiters: Dict[str, ResourceAllocation] = {}
+        self._mu = threading.RLock()
+        self._drain_lock = threading.Lock()
+        self._last_scale_at = 0.0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._on_allocate: List[Callable[[ResourceAllocation], None]] = []
+
+    # -- registry (:138-162) -------------------------------------------------
+
+    def register_resource(self, resource: Resource) -> None:
+        resource.last_heartbeat = self._clock.now()
+        with self._mu:
+            self._resources[resource.id] = resource
+        log.info("resource registered: %s (%s, caps=%s)",
+                 resource.id, resource.endpoint, sorted(resource.capabilities))
+        self.process_pending_once()
+
+    def register_topology_resources(self, topology: TpuTopology,
+                                    chips_per_resource: int = 8,
+                                    model_type: str = "llm",
+                                    tokens_per_s: float = 0.0) -> List[Resource]:
+        """Carve a slice topology into schedulable resources — the TPU
+        version of registering GPU endpoints: one resource per
+        ``chips_per_resource`` chips (e.g. one v5e-8 sub-slice each)."""
+        self.topology = topology
+        out = []
+        chips = topology.chips
+        for start in range(0, len(chips), chips_per_resource):
+            group = chips[start:start + chips_per_resource]
+            r = Resource(
+                id=f"{topology.slice_name}-r{start // chips_per_resource}",
+                model_type=model_type,
+                capabilities={"tpu", group[0].kind} if group else {"tpu"},
+                capacity={
+                    ResourceType.CHIP: float(len(group)),
+                    ResourceType.HBM_GB: sum(c.hbm_gb for c in group),
+                    **({ResourceType.TOKENS_PER_S: tokens_per_s}
+                       if tokens_per_s else {}),
+                },
+                endpoint=f"local://{topology.slice_name}/{start}",
+                metadata={"chip_ids": [c.id for c in group],
+                          "hosts": sorted({c.process_index for c in group})},
+            )
+            self.register_resource(r)
+            out.append(r)
+        return out
+
+    def unregister_resource(self, resource_id: str) -> bool:
+        with self._mu:
+            return self._resources.pop(resource_id, None) is not None
+
+    def get_resource(self, resource_id: str) -> Optional[Resource]:
+        with self._mu:
+            return self._resources.get(resource_id)
+
+    def resources(self) -> List[Resource]:
+        with self._mu:
+            return list(self._resources.values())
+
+    def heartbeat(self, resource_id: str) -> bool:
+        with self._mu:
+            r = self._resources.get(resource_id)
+            if r is None:
+                return False
+            r.last_heartbeat = self._clock.now()
+            if r.status == ResourceStatus.OFFLINE:
+                r.status = ResourceStatus.ONLINE
+                log.info("resource %s back online", resource_id)
+            return True
+
+    # -- allocation (:202-235, :336-398) -------------------------------------
+
+    def request_resource(self, request: ResourceRequest) -> Optional[ResourceAllocation]:
+        """Try to allocate now; on failure enqueue as pending and return
+        None (the caller polls ``get_allocation_for_request`` or registers
+        an ``on_allocate`` callback)."""
+        if request.created_at == 0.0:
+            request.created_at = self._clock.now()
+        alloc = self._try_allocate(request)
+        if alloc is not None:
+            return alloc
+        with self._mu:
+            self._pending.append(request)
+            self._pending.sort(key=lambda r: (int(r.priority), r.created_at))
+        log.info("request %s queued (priority=%s, pending=%d)",
+                 request.id, request.priority.tier_name, len(self._pending))
+        return None
+
+    def request_resource_now(self, request: ResourceRequest) -> ResourceAllocation:
+        """Allocate or raise NoResourceError (no pending queue)."""
+        if request.created_at == 0.0:
+            request.created_at = self._clock.now()
+        alloc = self._try_allocate(request)
+        if alloc is None:
+            raise NoResourceError(
+                f"no resource for model={request.model_type} "
+                f"caps={sorted(request.capabilities)} amounts={request.amounts}")
+        return alloc
+
+    def _try_allocate(self, request: ResourceRequest) -> Optional[ResourceAllocation]:
+        with self._mu:
+            candidates = [
+                r for r in self._resources.values()
+                if r.status == ResourceStatus.ONLINE
+                and r.model_type == request.model_type
+                and request.capabilities.issubset(r.capabilities)
+                and all(r.available(t) >= amt
+                        for t, amt in request.amounts.items())
+            ]
+            if not candidates:
+                return None
+            chosen = min(candidates, key=lambda r: r.load)
+            for t, amt in request.amounts.items():
+                chosen.used[t] = chosen.used.get(t, 0.0) + amt
+            now = self._clock.now()
+            # request.timeout bounds PENDING wait only; the allocation's
+            # lifetime is always the configured allocation_timeout (reusing
+            # the former for the latter would reclaim a resource out from
+            # under a live caller).
+            timeout = self.config.allocation_timeout
+            alloc = ResourceAllocation(
+                id=str(uuid.uuid4()),
+                resource_id=chosen.id,
+                request=request,
+                token=str(uuid.uuid4()),
+                allocated_at=now,
+                expires_at=now + timeout if timeout > 0 else 0.0,
+            )
+            self._allocations[alloc.id] = alloc
+            callbacks = list(self._on_allocate)
+        for cb in callbacks:
+            try:
+                cb(alloc)
+            except Exception:  # noqa: BLE001
+                log.exception("on_allocate callback failed")
+        return alloc
+
+    def on_allocate(self, cb: Callable[[ResourceAllocation], None]) -> None:
+        with self._mu:
+            self._on_allocate.append(cb)
+
+    def release_allocation(self, allocation_id: str, token: str) -> None:
+        with self._mu:
+            alloc = self._allocations.get(allocation_id)
+            if alloc is None:
+                raise AllocationNotFoundError(allocation_id)
+            if alloc.token != token:
+                raise PermissionError(
+                    f"bad token for allocation {allocation_id}")
+            self._release_locked(alloc)
+        self.process_pending_once()
+
+    def _release_locked(self, alloc: ResourceAllocation) -> None:
+        self._allocations.pop(alloc.id, None)
+        r = self._resources.get(alloc.resource_id)
+        if r is not None:
+            for t, amt in alloc.request.amounts.items():
+                r.used[t] = max(0.0, r.used.get(t, 0.0) - amt)
+
+    def get_allocation(self, allocation_id: str) -> Optional[ResourceAllocation]:
+        with self._mu:
+            return self._allocations.get(allocation_id)
+
+    def get_allocation_for_request(self, request_id: str) -> Optional[ResourceAllocation]:
+        with self._mu:
+            for a in self._allocations.values():
+                if a.request.id == request_id:
+                    return a
+            return None
+
+    def allocations(self) -> List[ResourceAllocation]:
+        with self._mu:
+            return list(self._allocations.values())
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._pending)
+
+    # -- pending processor (:418-474) ----------------------------------------
+
+    def process_pending_once(self) -> int:
+        """Drain what can now be satisfied; expire timed-out requests
+        (using created_at — the reference's metadata["queuedAt"] panic bug
+        is fixed by never having a queuedAt at all). Returns number
+        allocated."""
+        now = self._clock.now()
+        allocated = 0
+        # Serialise drains: concurrent callers (res-pending loop, release,
+        # register) must not snapshot the same request and allocate it twice.
+        with self._drain_lock:
+            with self._mu:
+                pending, self._pending = self._pending, []
+            survivors: List[ResourceRequest] = []
+            for req in pending:
+                if req.timeout > 0 and now - req.created_at > req.timeout:
+                    log.warning("pending request %s timed out after %.1fs",
+                                req.id, now - req.created_at)
+                    continue
+                alloc = self._try_allocate(req)
+                if alloc is None:
+                    survivors.append(req)
+                else:
+                    allocated += 1
+            with self._mu:
+                # _pending now holds only requests that arrived meanwhile.
+                self._pending = survivors + self._pending
+                self._pending.sort(key=lambda r: (int(r.priority), r.created_at))
+        return allocated
+
+    # -- monitor (:401-415, :477-571) ----------------------------------------
+
+    def run_monitor_once(self) -> Dict[str, int]:
+        now = self._clock.now()
+        offline = expired = 0
+        with self._mu:
+            for r in self._resources.values():
+                if (r.status != ResourceStatus.OFFLINE
+                        and self.config.heartbeat_timeout > 0
+                        and now - r.last_heartbeat > self.config.heartbeat_timeout):
+                    r.status = ResourceStatus.OFFLINE
+                    offline += 1
+                    log.warning("resource %s offline (heartbeat timeout)", r.id)
+            for alloc in list(self._allocations.values()):
+                if alloc.expires_at and alloc.expires_at <= now:
+                    self._release_locked(alloc)
+                    expired += 1
+                    log.warning("allocation %s expired; reclaimed", alloc.id)
+        self._check_autoscale(now)
+        if expired:
+            self.process_pending_once()
+        return {"offline": offline, "expired_allocations": expired}
+
+    def _check_autoscale(self, now: float) -> None:
+        """Thresholds + cooldown (:525-571) with REAL actuators."""
+        if now - self._last_scale_at < self.config.scale_cooldown:
+            return
+        with self._mu:
+            online = [r for r in self._resources.values()
+                      if r.status == ResourceStatus.ONLINE]
+            if not online:
+                return
+            avg_load = sum(r.load for r in online) / len(online)
+            pending = len(self._pending)
+        if (avg_load >= self.config.scale_up_load or pending > 0) and self._scale_up_fn:
+            self._last_scale_at = now
+            self._scale_up_fn(
+                f"avg_load={avg_load:.2f} pending={pending}")
+        elif avg_load <= self.config.scale_down_load and pending == 0 and self._scale_down_fn:
+            self._last_scale_at = now
+            self._scale_down_fn(f"avg_load={avg_load:.2f}")
+
+    # -- background threads --------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for name, target, interval in (
+                ("res-monitor", self.run_monitor_once, self.config.monitor_interval),
+                ("res-pending", self.process_pending_once,
+                 self.config.pending_process_interval)):
+            t = threading.Thread(
+                target=self._loop, args=(target, interval), name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+
+    def _loop(self, fn, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                log.exception("scheduler loop %s failed", fn.__name__)
+
+    # -- stats ---------------------------------------------------------------
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            return {
+                "resources": len(self._resources),
+                "online": sum(1 for r in self._resources.values()
+                              if r.status == ResourceStatus.ONLINE),
+                "allocations": len(self._allocations),
+                "pending_requests": len(self._pending),
+                "avg_load": (
+                    sum(r.load for r in self._resources.values())
+                    / len(self._resources) if self._resources else 0.0),
+                "topology": self.topology.to_dict() if self.topology else None,
+            }

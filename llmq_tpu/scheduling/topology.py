@@ -1,0 +1,124 @@
+"""TPU topology discovery and declaration.
+
+New scope (no reference counterpart): the reference's ResourceScheduler
+tracks abstract {cpu, gpu, memory, tokens} capacities
+(resource_scheduler.go:17-22) attached to external endpoint URLs. The TPU
+build needs real chip/slice topology so the scheduler can do
+priority-aware chip allocation (BASELINE north star: "reads pod-slice
+topology").
+
+Discovery uses ``jax.devices()`` when available; tests and control-plane
+processes can declare a topology without importing jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("topology")
+
+# HBM per chip in GB for known TPU generations (public specs).
+_HBM_GB = {
+    "v4": 32.0,
+    "v5e": 16.0,
+    "v5 lite": 16.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+
+
+@dataclass
+class ChipInfo:
+    id: int
+    kind: str = "unknown"          # e.g. "TPU v5 lite"
+    process_index: int = 0          # host this chip belongs to
+    coords: Optional[tuple] = None  # ICI mesh coordinates if known
+    hbm_gb: float = 16.0
+
+    def to_dict(self) -> Dict:
+        return {
+            "id": self.id,
+            "kind": self.kind,
+            "process_index": self.process_index,
+            "coords": self.coords,
+            "hbm_gb": self.hbm_gb,
+        }
+
+
+@dataclass
+class TpuTopology:
+    """A slice: chips grouped by host (process)."""
+
+    chips: List[ChipInfo] = field(default_factory=list)
+    num_hosts: int = 1
+    slice_name: str = "slice0"
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+    @property
+    def total_hbm_gb(self) -> float:
+        return sum(c.hbm_gb for c in self.chips)
+
+    def chips_on_host(self, process_index: int) -> List[ChipInfo]:
+        return [c for c in self.chips if c.process_index == process_index]
+
+    def to_dict(self) -> Dict:
+        return {
+            "slice_name": self.slice_name,
+            "num_chips": self.num_chips,
+            "num_hosts": self.num_hosts,
+            "total_hbm_gb": self.total_hbm_gb,
+            "chips": [c.to_dict() for c in self.chips],
+        }
+
+    @classmethod
+    def declare(cls, num_chips: int, num_hosts: int = 1, kind: str = "v5e",
+                slice_name: str = "slice0") -> "TpuTopology":
+        """Declare a topology without hardware (control plane / tests),
+        e.g. ``declare(8)`` for v5e-8, ``declare(16, num_hosts=2)`` for a
+        2-host v5e-16 (BASELINE config #5)."""
+        hbm = _hbm_for(kind)
+        per_host = max(1, num_chips // max(1, num_hosts))
+        chips = [
+            ChipInfo(id=i, kind=kind, process_index=i // per_host, hbm_gb=hbm)
+            for i in range(num_chips)
+        ]
+        return cls(chips=chips, num_hosts=num_hosts, slice_name=slice_name)
+
+    @classmethod
+    def discover(cls) -> "TpuTopology":
+        """Discover from the live JAX runtime (any platform; CPU devices
+        appear as chips with a nominal HBM so the scheduler stays
+        exercisable in tests)."""
+        import jax  # deferred: control-plane processes may not want jax
+
+        devices = jax.devices()
+        chips = []
+        for d in devices:
+            kind = getattr(d, "device_kind", "unknown")
+            chips.append(ChipInfo(
+                id=d.id,
+                kind=kind,
+                process_index=getattr(d, "process_index", 0),
+                coords=tuple(getattr(d, "coords", ()) or ()) or None,
+                hbm_gb=_hbm_for(kind),
+            ))
+        n_hosts = len({c.process_index for c in chips}) or 1
+        topo = cls(chips=chips, num_hosts=n_hosts)
+        log.info("discovered topology: %d chips on %d host(s), kind=%s",
+                 topo.num_chips, topo.num_hosts,
+                 chips[0].kind if chips else "n/a")
+        return topo
+
+
+def _hbm_for(kind: str) -> float:
+    k = kind.lower()
+    for key, gb in _HBM_GB.items():
+        if key in k:
+            return gb
+    return 16.0

@@ -126,3 +126,79 @@ class TestTryPopTransparency:
         with pytest.raises(QueueNotFoundError):
             qm.try_pop_message("typo_queue")
         assert qm.try_pop_message("normal") is None  # empty → None
+
+
+class TestWorkerBackoffAlwaysReal:
+    def test_worker_without_explicit_delayed_queue_honors_backoff(
+            self, fake_clock, queue_backend):
+        # Review finding: bare Worker used to re-push instantly, burning
+        # all retries in milliseconds.
+        from llmq_tpu.queueing.worker import Worker
+
+        attempts = []
+
+        def flaky(ctx, m):
+            attempts.append(fake_clock.now())
+            raise RuntimeError("down")
+
+        qm = QueueManager("bare", clock=fake_clock, enable_metrics=False,
+                          backend=queue_backend)
+        w = Worker("w", qm, flaky, clock=fake_clock)  # no delayed_queue arg
+        qm.push_message(Message(max_retries=2))
+        w.process_batch()
+        assert len(attempts) == 1
+        # Immediately re-running must NOT retry (backoff not elapsed).
+        w.process_batch()
+        assert len(attempts) == 1
+        fake_clock.advance(1.01)
+        w.process_batch()  # owned delayed queue ticked synchronously
+        assert len(attempts) == 2
+
+
+class TestEnvValidation:
+    def test_env_override_rejects_bad_strategy(self, monkeypatch):
+        from llmq_tpu.core.config import load_config
+
+        monkeypatch.setenv("LLMQ_LOADBALANCER_STRATEGY", "fastest")
+        with pytest.raises(ValueError):
+            load_config()
+
+
+class TestAffinitySaturation:
+    def test_sticky_session_respects_max_connections(self, fake_clock):
+        from llmq_tpu.core.config import LoadBalancerConfig
+        from llmq_tpu.core.errors import NoEndpointError
+        from llmq_tpu.loadbalancer import Endpoint, LoadBalancer
+
+        lb = LoadBalancer(LoadBalancerConfig(health_check_interval=0),
+                          clock=fake_clock)
+        lb.add_endpoint(Endpoint(id="e0", max_connections=1))
+        lb.add_endpoint(Endpoint(id="e1", max_connections=1))
+        first = lb.get_endpoint(session_id="s").id
+        # Pinned endpoint saturated → affinity must not oversubscribe it.
+        second = lb.get_endpoint(session_id="s").id
+        assert second != first
+        with pytest.raises(NoEndpointError):
+            lb.get_endpoint(session_id="s")
+
+
+class TestAllocationTTLIndependentOfPendingTimeout:
+    def test_short_pending_timeout_does_not_shorten_allocation(self, fake_clock):
+        from llmq_tpu.core.config import ResourceSchedulerConfig
+        from llmq_tpu.scheduling import (
+            Resource, ResourceRequest, ResourceScheduler, ResourceType)
+
+        cfg = ResourceSchedulerConfig(allocation_timeout=300.0)
+        rs = ResourceScheduler(cfg, clock=fake_clock)
+        rs.register_resource(Resource(
+            id="r0", capabilities={"tpu"},
+            capacity={ResourceType.CHIP: 8.0}))
+        req = ResourceRequest(capabilities={"tpu"},
+                              amounts={ResourceType.CHIP: 4.0}, timeout=5.0)
+        alloc = rs.request_resource_now(req)
+        rs.heartbeat("r0")
+        fake_clock.advance(10.0)  # > pending timeout, < allocation TTL
+        rs.heartbeat("r0")
+        out = rs.run_monitor_once()
+        assert out["expired_allocations"] == 0
+        assert rs.get_allocation(alloc.id) is not None

@@ -1,0 +1,204 @@
+"""LoadBalancer tests.
+
+Mirrors reference tests/loadbalancer_test.go: all 4 strategies (RR
+fairness :18-64, least-conn :67-105, weighted-random distribution over
+1000 draws :108-150, adaptive best-endpoint :153-197), health filtering +
+status update (:200-253), add/remove (:256-306), session affinity
+(:309-366) — plus the real health-probe state machine the reference
+stubs."""
+
+import random
+
+import pytest
+
+from llmq_tpu.core.config import LoadBalancerConfig
+from llmq_tpu.core.errors import NoEndpointError
+from llmq_tpu.core.types import Message
+from llmq_tpu.loadbalancer import Endpoint, EndpointStatus, LoadBalancer
+
+
+def make_lb(strategy="round_robin", fake_clock=None, probe=None, seed=7,
+            session_affinity=True):
+    cfg = LoadBalancerConfig(strategy=strategy, health_check_interval=0,
+                             session_affinity=session_affinity)
+    return LoadBalancer(cfg, clock=fake_clock, probe=probe,
+                        rng=random.Random(seed))
+
+
+def eps(n, **kw):
+    return [Endpoint(id=f"e{i}", url=f"local://e{i}", **kw) for i in range(n)]
+
+
+class TestRoundRobin:
+    def test_fairness(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        for e in eps(3):
+            lb.add_endpoint(e)
+        picks = [lb.get_endpoint().id for _ in range(9)]
+        assert picks.count("e0") == picks.count("e1") == picks.count("e2") == 3
+
+    def test_per_type_cursor(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        lb.add_endpoint(Endpoint(id="a0", model_type="llm"))
+        lb.add_endpoint(Endpoint(id="a1", model_type="llm"))
+        lb.add_endpoint(Endpoint(id="b0", model_type="embed"))
+        m = Message(metadata={"model_type": "embed"})
+        assert lb.get_endpoint(m).id == "b0"
+        assert lb.get_endpoint().id in ("a0", "a1")
+
+
+class TestLeastConnections:
+    def test_picks_least_busy(self, fake_clock):
+        lb = make_lb("least_connections", fake_clock)
+        for e in eps(3):
+            lb.add_endpoint(e)
+        lb.get_endpoint_by_id("e0").connections = 5
+        lb.get_endpoint_by_id("e1").connections = 1
+        lb.get_endpoint_by_id("e2").connections = 3
+        assert lb.get_endpoint().id == "e1"
+
+
+class TestWeightedRandom:
+    def test_distribution(self, fake_clock):
+        lb = make_lb("weighted_random", fake_clock, session_affinity=False)
+        lb.add_endpoint(Endpoint(id="heavy", weight=9.0))
+        lb.add_endpoint(Endpoint(id="light", weight=1.0))
+        picks = []
+        for _ in range(1000):
+            ep = lb.get_endpoint()
+            picks.append(ep.id)
+            lb.release_endpoint(ep.id)
+        frac_heavy = picks.count("heavy") / 1000
+        assert 0.8 < frac_heavy < 0.98  # statistical, mirrors :108-150
+
+
+class TestAdaptive:
+    def test_picks_best_scored(self, fake_clock):
+        lb = make_lb("adaptive_load", fake_clock, seed=1)
+        lb.add_endpoint(Endpoint(id="bad", response_time=2.0, error_rate=0.5))
+        lb.add_endpoint(Endpoint(id="good", response_time=0.1, error_rate=0.0))
+        wins = sum(lb.get_endpoint().id == "good" for _ in range(50))
+        assert wins >= 40  # 10% exploration allowed
+
+
+class TestHealthFiltering:
+    def test_unhealthy_excluded(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        for e in eps(2):
+            lb.add_endpoint(e)
+        lb.set_endpoint_status("e0", EndpointStatus.UNHEALTHY)
+        assert all(lb.get_endpoint().id == "e1" for _ in range(5))
+
+    def test_degraded_still_selectable(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        lb.add_endpoint(Endpoint(id="e0", status=EndpointStatus.DEGRADED))
+        assert lb.get_endpoint().id == "e0"
+
+    def test_no_endpoint_raises(self, fake_clock):
+        lb = make_lb(fake_clock=fake_clock)
+        with pytest.raises(NoEndpointError):
+            lb.get_endpoint()
+
+    def test_max_connections_respected(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        lb.add_endpoint(Endpoint(id="e0", max_connections=1))
+        lb.get_endpoint()
+        with pytest.raises(NoEndpointError):
+            lb.get_endpoint()
+
+
+class TestHealthProbe:
+    def test_state_machine(self, fake_clock):
+        # Fix of the reference's always-healthy stub (:588-616).
+        health = {"ok": True}
+        lb = make_lb(fake_clock=fake_clock, probe=lambda ep: health["ok"])
+        lb.add_endpoint(Endpoint(id="e0"))
+        health["ok"] = False
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.DEGRADED
+        lb.check_health_once()
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.UNHEALTHY
+        # Recovery passes through degraded.
+        health["ok"] = True
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.UNHEALTHY
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.DEGRADED
+        lb.check_health_once()
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.HEALTHY
+
+    def test_probe_crash_counts_as_failure(self, fake_clock):
+        def bad_probe(ep):
+            raise RuntimeError("probe broke")
+        lb = make_lb(fake_clock=fake_clock, probe=bad_probe)
+        lb.add_endpoint(Endpoint(id="e0"))
+        lb.check_health_once()
+        assert lb.get_endpoint_by_id("e0").status == EndpointStatus.DEGRADED
+
+
+class TestAddRemove:
+    def test_add_remove(self, fake_clock):
+        lb = make_lb(fake_clock=fake_clock)
+        lb.add_endpoint(Endpoint(id="e0"))
+        assert lb.remove_endpoint("e0")
+        assert not lb.remove_endpoint("e0")
+        assert lb.endpoints() == []
+
+    def test_remove_clears_sessions(self, fake_clock):
+        lb = make_lb(fake_clock=fake_clock)
+        lb.add_endpoint(Endpoint(id="e0"))
+        lb.get_endpoint(session_id="s1")
+        assert lb.get_session_endpoint("s1") is not None
+        lb.remove_endpoint("e0")
+        assert lb.get_session_endpoint("s1") is None
+
+
+class TestSessionAffinity:
+    def test_sticky(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        for e in eps(3):
+            lb.add_endpoint(e)
+        first = lb.get_endpoint(session_id="conv-1").id
+        for _ in range(5):
+            assert lb.get_endpoint(session_id="conv-1").id == first
+
+    def test_ttl_expiry(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        lb.config.session_ttl = 10.0
+        for e in eps(2):
+            lb.add_endpoint(e)
+        lb.get_endpoint(session_id="s")
+        fake_clock.advance(11.0)
+        assert lb.cleanup_sessions() == 1
+        assert lb.session_count() == 0
+
+    def test_affinity_skips_unhealthy(self, fake_clock):
+        lb = make_lb("round_robin", fake_clock)
+        for e in eps(2):
+            lb.add_endpoint(e)
+        first = lb.get_endpoint(session_id="s").id
+        lb.set_endpoint_status(first, EndpointStatus.UNHEALTHY)
+        other = lb.get_endpoint(session_id="s").id
+        assert other != first
+
+
+class TestRelease:
+    def test_ewma_and_error_decay(self, fake_clock):
+        lb = make_lb(fake_clock=fake_clock)
+        lb.add_endpoint(Endpoint(id="e0"))
+        lb.get_endpoint()
+        lb.release_endpoint("e0", response_time=1.0)
+        assert lb.get_endpoint_by_id("e0").response_time == 1.0
+        lb.get_endpoint()
+        lb.release_endpoint("e0", response_time=2.0)
+        # EWMA 9:1 (:311-317).
+        assert lb.get_endpoint_by_id("e0").response_time == pytest.approx(1.1)
+        lb.get_endpoint()
+        lb.release_endpoint("e0", is_error=True)
+        assert lb.get_endpoint_by_id("e0").error_rate == pytest.approx(0.1)
+        lb.get_endpoint()
+        lb.release_endpoint("e0")
+        assert lb.get_endpoint_by_id("e0").error_rate == pytest.approx(0.095)
+        assert lb.get_endpoint_by_id("e0").connections == 0

@@ -1,0 +1,106 @@
+"""Preprocessor tests.
+
+Mirrors reference tests/preprocessor_test.go:25-149 (keyword promotion,
+user_priority override, explicit-priority respect, metadata preservation,
+realtime keywords, question/sentiment analysis)."""
+
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.preprocessor import Preprocessor, analyze_message_content
+
+
+class TestPriorityInference:
+    def test_keyword_promotion_high(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="This is urgent, please handle"))
+        assert m.priority == Priority.HIGH
+
+    def test_keyword_promotion_realtime(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="emergency! respond right now"))
+        assert m.priority == Priority.REALTIME
+
+    def test_most_hits_wins(self):
+        p = Preprocessor()
+        m = p.process_message(Message(
+            content="urgent important critical but also asap"))
+        # 3 high hits vs 1 realtime hit → HIGH.
+        assert m.priority == Priority.HIGH
+
+    def test_explicit_priority_respected(self):
+        # preprocessor.go:63-65: explicit non-default priority wins.
+        p = Preprocessor()
+        m = p.process_message(Message(content="urgent!!", priority=Priority.LOW))
+        assert m.priority == Priority.LOW
+
+    def test_user_priority_metadata_override(self):
+        p = Preprocessor()
+        m = p.process_message(Message(
+            content="hello", metadata={"user_priority": 1}))
+        assert m.priority == Priority.REALTIME
+
+    def test_invalid_metadata_override_ignored(self):
+        p = Preprocessor()
+        m = p.process_message(Message(
+            content="hello", metadata={"user_priority": "not-a-priority"}))
+        assert m.priority == Priority.NORMAL
+
+    def test_per_user_default(self):
+        p = Preprocessor()
+        p.set_user_priority("vip-user", Priority.HIGH)
+        m = p.process_message(Message(content="hello", user_id="vip-user"))
+        assert m.priority == Priority.HIGH
+        assert p.remove_user_priority("vip-user")
+        m2 = p.process_message(Message(content="hello", user_id="vip-user"))
+        assert m2.priority == Priority.NORMAL
+
+    def test_override_order_metadata_beats_user_default(self):
+        p = Preprocessor()
+        p.set_user_priority("u", Priority.LOW)
+        m = p.process_message(Message(
+            content="x", user_id="u", metadata={"user_priority": "high"}))
+        assert m.priority == Priority.HIGH
+
+    def test_no_keywords_stays_normal(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="just a plain question here"))
+        assert m.priority == Priority.NORMAL
+
+    def test_keyword_needs_word_boundary(self):
+        p = Preprocessor()
+        # "soonish" should not match "soon".
+        m = p.process_message(Message(content="see you soonish"))
+        assert m.priority == Priority.NORMAL
+
+
+class TestContentAnalysis:
+    def test_metadata_annotations(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="Why is this broken and awful?"))
+        assert m.metadata["analyzed"] is True
+        assert m.metadata["is_question"] is True
+        assert m.metadata["sentiment"] == "negative"
+        assert m.metadata["word_count"] == 6
+
+    def test_positive_sentiment(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="this is great, thanks a lot"))
+        assert m.metadata["sentiment"] == "positive"
+
+    def test_existing_metadata_preserved(self):
+        p = Preprocessor()
+        m = p.process_message(Message(content="hi", metadata={"keep": "me"}))
+        assert m.metadata["keep"] == "me"
+
+    def test_analysis_disabled(self):
+        p = Preprocessor(enable_content_analysis=False)
+        m = p.process_message(Message(content="what?"))
+        assert "sentiment" not in m.metadata
+        assert m.metadata["analyzed"] is True
+
+    def test_standalone_analysis_does_not_mutate(self):
+        m = Message(content="urgent thing?")
+        analysis = analyze_message_content(m)
+        assert analysis["suggested_priority"] == int(Priority.HIGH)
+        assert analysis["is_question"] is True
+        assert "analyzed" not in m.metadata
+        assert m.priority == Priority.NORMAL

@@ -1,0 +1,275 @@
+"""Resource scheduler + autoscaler + topology tests.
+
+The reference has ZERO coverage for internal/scheduler (SURVEY.md §4);
+these tests cover the ported surface plus the TPU generalisation."""
+
+import time
+
+import pytest
+
+from llmq_tpu.core.config import ResourceSchedulerConfig, SchedulerConfig
+from llmq_tpu.core.errors import NoResourceError
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.loadbalancer import Endpoint, LoadBalancer
+from llmq_tpu.core.config import LoadBalancerConfig
+from llmq_tpu.queueing.queue_manager import QueueManager
+from llmq_tpu.scheduling import (
+    Autoscaler,
+    Resource,
+    ResourceRequest,
+    ResourceScheduler,
+    ResourceStatus,
+    ResourceType,
+    TpuTopology,
+)
+
+
+def chip_resource(rid="r0", chips=8.0, hbm=128.0, **kw):
+    return Resource(
+        id=rid,
+        capabilities={"tpu"},
+        capacity={ResourceType.CHIP: chips, ResourceType.HBM_GB: hbm},
+        **kw,
+    )
+
+
+def chip_request(chips=4.0, hbm=64.0, **kw):
+    return ResourceRequest(
+        capabilities={"tpu"},
+        amounts={ResourceType.CHIP: chips, ResourceType.HBM_GB: hbm},
+        **kw,
+    )
+
+
+class TestTopology:
+    def test_declare_v5e8(self):
+        topo = TpuTopology.declare(8, kind="v5e")
+        assert topo.num_chips == 8
+        assert topo.total_hbm_gb == 128.0
+
+    def test_declare_multihost(self):
+        # BASELINE config #5: v5e-16 over 2 hosts.
+        topo = TpuTopology.declare(16, num_hosts=2, kind="v5e")
+        assert topo.num_hosts == 2
+        assert len(topo.chips_on_host(0)) == 8
+        assert len(topo.chips_on_host(1)) == 8
+
+    def test_discover_on_cpu_mesh(self):
+        # conftest forces 8 virtual CPU devices.
+        topo = TpuTopology.discover()
+        assert topo.num_chips == 8
+
+
+class TestAllocation:
+    def test_allocate_and_release(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource())
+        alloc = rs.request_resource_now(chip_request())
+        r = rs.get_resource("r0")
+        assert r.used[ResourceType.CHIP] == 4.0
+        assert r.load == pytest.approx(0.5)
+        rs.release_allocation(alloc.id, alloc.token)
+        assert r.used[ResourceType.CHIP] == 0.0
+        assert r.load == 0.0
+
+    def test_bad_token_rejected(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource())
+        alloc = rs.request_resource_now(chip_request())
+        with pytest.raises(PermissionError):
+            rs.release_allocation(alloc.id, "wrong")
+
+    def test_lowest_load_wins(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        busy = chip_resource("busy")
+        busy.used = {ResourceType.CHIP: 6.0, ResourceType.HBM_GB: 96.0}
+        rs.register_resource(busy)
+        rs.register_resource(chip_resource("idle"))
+        alloc = rs.request_resource_now(chip_request(chips=2.0, hbm=32.0))
+        assert alloc.resource_id == "idle"
+
+    def test_capability_filter(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource())  # caps={"tpu"}
+        req = chip_request()
+        req.capabilities = {"tpu", "fp8"}
+        with pytest.raises(NoResourceError):
+            rs.request_resource_now(req)
+
+    def test_capacity_filter(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource(chips=2.0, hbm=32.0))
+        with pytest.raises(NoResourceError):
+            rs.request_resource_now(chip_request(chips=4.0, hbm=64.0))
+
+    def test_offline_excluded(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        r = chip_resource()
+        r.status = ResourceStatus.OFFLINE
+        rs.register_resource(r)
+        with pytest.raises(NoResourceError):
+            rs.request_resource_now(chip_request())
+
+
+class TestPendingQueue:
+    def test_queued_then_allocated_on_release(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource())
+        first = rs.request_resource_now(chip_request(chips=8.0, hbm=128.0))
+        second = chip_request(chips=8.0, hbm=128.0)
+        assert rs.request_resource(second) is None
+        assert rs.pending_count() == 1
+        rs.release_allocation(first.id, first.token)  # triggers pending drain
+        assert rs.pending_count() == 0
+        assert rs.get_allocation_for_request(second.id) is not None
+
+    def test_priority_order(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource())
+        blocker = rs.request_resource_now(chip_request(chips=8.0, hbm=128.0))
+        low = chip_request(chips=8.0, hbm=128.0, priority=Priority.LOW)
+        rt = chip_request(chips=8.0, hbm=128.0, priority=Priority.REALTIME)
+        rs.request_resource(low)
+        rs.request_resource(rt)
+        rs.release_allocation(blocker.id, blocker.token)
+        # Realtime wins the freed capacity despite arriving later.
+        assert rs.get_allocation_for_request(rt.id) is not None
+        assert rs.get_allocation_for_request(low.id) is None
+
+    def test_pending_timeout_no_panic(self, fake_clock):
+        # The reference panics here (resource_scheduler.go:454 reads
+        # metadata["queuedAt"] that is never written).
+        rs = ResourceScheduler(clock=fake_clock)
+        rs.register_resource(chip_resource(chips=1.0, hbm=16.0))
+        req = chip_request(chips=8.0, hbm=128.0, timeout=5.0)
+        rs.request_resource(req)
+        fake_clock.advance(6.0)
+        rs.process_pending_once()
+        assert rs.pending_count() == 0  # expired, cleanly
+
+
+class TestMonitor:
+    def test_heartbeat_timeout_offline_and_recovery(self, fake_clock):
+        cfg = ResourceSchedulerConfig(heartbeat_timeout=30.0)
+        rs = ResourceScheduler(cfg, clock=fake_clock)
+        rs.register_resource(chip_resource())
+        fake_clock.advance(31.0)
+        out = rs.run_monitor_once()
+        assert out["offline"] == 1
+        assert rs.get_resource("r0").status == ResourceStatus.OFFLINE
+        rs.heartbeat("r0")
+        assert rs.get_resource("r0").status == ResourceStatus.ONLINE
+
+    def test_allocation_expiry_reclaims(self, fake_clock):
+        cfg = ResourceSchedulerConfig(allocation_timeout=10.0)
+        rs = ResourceScheduler(cfg, clock=fake_clock)
+        rs.register_resource(chip_resource())
+        rs.request_resource_now(chip_request())
+        rs.heartbeat("r0")
+        fake_clock.advance(11.0)
+        rs.heartbeat("r0")
+        out = rs.run_monitor_once()
+        assert out["expired_allocations"] == 1
+        assert rs.get_resource("r0").load == 0.0
+
+    def test_autoscale_actuators_fire(self, fake_clock):
+        ups, downs = [], []
+        cfg = ResourceSchedulerConfig(scale_up_load=0.8, scale_down_load=0.2,
+                                      scale_cooldown=100.0)
+        rs = ResourceScheduler(cfg, clock=fake_clock,
+                               scale_up_fn=ups.append, scale_down_fn=downs.append)
+        r = chip_resource()
+        rs.register_resource(r)
+        r.used = {ResourceType.CHIP: 8.0, ResourceType.HBM_GB: 128.0}
+        fake_clock.advance(200.0)
+        rs.heartbeat("r0")
+        rs.run_monitor_once()
+        assert len(ups) == 1
+        r.used = {}
+        fake_clock.advance(200.0)
+        rs.heartbeat("r0")
+        rs.run_monitor_once()
+        assert len(downs) == 1
+
+
+class TestTopologyCarving:
+    def test_register_topology_resources(self, fake_clock):
+        rs = ResourceScheduler(clock=fake_clock)
+        topo = TpuTopology.declare(16, num_hosts=2, kind="v5e")
+        rows = rs.register_topology_resources(topo, chips_per_resource=8)
+        assert len(rows) == 2
+        assert rows[0].capacity[ResourceType.CHIP] == 8.0
+        assert rows[0].capacity[ResourceType.HBM_GB] == 128.0
+        assert rs.get_stats()["topology"]["num_chips"] == 16
+
+
+class TestAutoscaler:
+    def _setup(self, fake_clock, strategy="dynamic", pending=0):
+        qm = QueueManager("as", clock=fake_clock, enable_metrics=False)
+        for _ in range(pending):
+            qm.push_message(Message())
+        lb = LoadBalancer(LoadBalancerConfig(health_check_interval=0),
+                          clock=fake_clock)
+        cfg = SchedulerConfig(strategy=strategy, scale_up_threshold=10,
+                              scale_down_threshold=1, min_endpoints=1,
+                              max_endpoints=3, cooldown=0.0)
+        provisioned = []
+
+        def provision(seq):
+            ep = Endpoint(id=f"auto-{seq}", url=f"local://auto-{seq}")
+            provisioned.append(ep)
+            return ep
+
+        decommissioned = []
+        a = Autoscaler(qm, lb, cfg, provision_fn=provision,
+                       decommission_fn=decommissioned.append, clock=fake_clock)
+        return qm, lb, a, provisioned, decommissioned
+
+    def test_dynamic_scale_up_actuates(self, fake_clock):
+        qm, lb, a, prov, _ = self._setup(fake_clock, pending=20)
+        lb.add_endpoint(Endpoint(id="seed"))
+        out = a.run_once()
+        assert out["action"] == "up"
+        assert len(lb.endpoints()) == 2
+        assert len(prov) == 1
+
+    def test_dynamic_scale_down_actuates(self, fake_clock):
+        qm, lb, a, _, deco = self._setup(fake_clock, pending=0)
+        lb.add_endpoint(Endpoint(id="seed-0"))
+        lb.add_endpoint(Endpoint(id="seed-1"))
+        out = a.run_once()
+        assert out["action"] == "down"
+        assert len(lb.endpoints()) == 1
+        assert len(deco) == 1
+
+    def test_respects_min_max(self, fake_clock):
+        qm, lb, a, _, _ = self._setup(fake_clock, pending=0)
+        lb.add_endpoint(Endpoint(id="only"))
+        assert a.run_once()["action"] == "none"  # already at min
+
+    def test_cooldown(self, fake_clock):
+        qm, lb, a, _, _ = self._setup(fake_clock, pending=20)
+        a.config.cooldown = 60.0
+        lb.add_endpoint(Endpoint(id="seed"))
+        assert a.run_once()["action"] == "up"
+        assert a.run_once()["action"] == "cooldown"
+        fake_clock.advance(61.0)
+        assert a.run_once()["action"] == "up"
+
+    def test_adaptive_business_hours(self, fake_clock):
+        qm, lb, a, prov, _ = self._setup(fake_clock, strategy="adaptive")
+        lb.add_endpoint(Endpoint(id="seed"))
+        a._localtime = lambda: time.struct_time((2026, 7, 29, 11, 0, 0, 2, 210, 0))
+        out = a.run_once()   # Wednesday 11:00 → near-max endpoints
+        assert out["action"] == "up"
+        assert len(lb.endpoints()) == 2  # max-1 = 2
+
+    def test_hybrid_applies_weights(self, fake_clock):
+        qm, lb, a, _, _ = self._setup(fake_clock, strategy="hybrid", pending=5)
+        fast = Endpoint(id="fast", response_time=0.1)
+        slow = Endpoint(id="slow", response_time=1.0)
+        lb.add_endpoint(fast)
+        lb.add_endpoint(slow)
+        a.run_once()
+        assert fast.weight == 1.0
+        assert slow.weight == pytest.approx(0.1)
