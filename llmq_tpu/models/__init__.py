@@ -1,0 +1,9 @@
+from llmq_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    MODEL_CONFIGS,
+    get_config,
+    init_params,
+    forward_prefill,
+    forward_decode,
+)
+from llmq_tpu.models.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

@@ -1,0 +1,115 @@
+"""Model checkpointing (orbax) + Hugging Face weight import.
+
+New scope (no reference counterpart — SURVEY.md §5 notes the reference
+has no system checkpointing at all): save/restore the param pytree with
+orbax, and map Hugging Face Llama checkpoints into our layout for real
+Llama-3-8B/70B weights (BASELINE configs #2-#5)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.models.llama import LlamaConfig, Params
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
+
+def save_checkpoint(path: str, params: Params) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params)
+    ckptr.wait_until_finished()
+    log.info("checkpoint saved to %s", path)
+
+
+def load_checkpoint(path: str, template: Optional[Params] = None) -> Params:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if template is not None:
+        shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        return ckptr.restore(path, target=shapes)
+    return ckptr.restore(path)
+
+
+# -- Hugging Face import ------------------------------------------------------
+
+def _permute_rope(w: np.ndarray, n_heads: int, dim_in: int) -> np.ndarray:
+    """Undo HF's rotary permutation so weights match our split-half RoPE.
+
+    HF stores q/k projections permuted for their interleaved rotary; our
+    apply_rope uses the split-half (NeoX) layout, which equals HF's
+    convention after this inverse permutation. w: (n_heads*head_dim, dim_in)
+    in HF (out, in) orientation."""
+    head_dim = w.shape[0] // n_heads
+    w = w.reshape(n_heads, 2, head_dim // 2, dim_in)
+    w = w.transpose(0, 2, 1, 3).reshape(n_heads * head_dim, dim_in)
+    return w
+
+
+def import_hf_llama(model_dir: str, cfg: LlamaConfig) -> Params:
+    """Convert a local Hugging Face Llama checkpoint directory
+    (safetensors) into our stacked-layer pytree. Requires the
+    ``safetensors`` package (bundled with transformers)."""
+    from safetensors import safe_open  # type: ignore[import-not-found]
+
+    files = sorted(f for f in os.listdir(model_dir)
+                   if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors in {model_dir}")
+    tensors: Dict[str, np.ndarray] = {}
+    for fname in files:
+        with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+
+    def get(name: str) -> np.ndarray:
+        return tensors[name]
+
+    L = cfg.n_layers
+    dt = cfg.dtype
+
+    def stack(fmt: str, transform=None) -> jnp.ndarray:
+        mats = []
+        for i in range(L):
+            w = get(fmt.format(i=i))
+            if transform is not None:
+                w = transform(w)
+            mats.append(w.T)  # HF stores (out, in); we use (in, out)
+        return jnp.asarray(np.stack(mats), dtype=dt)
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dt),
+        "layers": {
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight",
+                        lambda w: _permute_rope(w, cfg.n_heads, w.shape[1])),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight",
+                        lambda w: _permute_rope(w, cfg.n_kv_heads, w.shape[1])),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+            "attn_norm": jnp.asarray(np.stack(
+                [get(f"model.layers.{i}.input_layernorm.weight")
+                 for i in range(L)]), dtype=dt),
+            "mlp_norm": jnp.asarray(np.stack(
+                [get(f"model.layers.{i}.post_attention_layernorm.weight")
+                 for i in range(L)]), dtype=dt),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dt),
+    }
+    if "lm_head.weight" in tensors:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dt)
+    log.info("imported HF llama from %s (%d tensors)", model_dir, len(tensors))
+    return params

@@ -1,0 +1,327 @@
+"""Llama-3 family in pure JAX (functional, scan-over-layers, paged KV).
+
+New scope: the reference serves models behind external HTTP endpoints and
+has no model code (SURVEY.md §2.2); this is the in-tree TPU model layer
+for BASELINE configs #2/#3/#5 (8B single chip, KV reuse, 70B TP).
+
+Design notes (TPU-first):
+
+- **Stacked layer parameters + ``lax.scan``**: one trace/compile of the
+  layer body instead of n_layers copies — compile time stays flat from
+  tiny to 70B.
+- **Paged KV cache**: global page pools ``(L, P, page_size, H_kv, D)``
+  indexed by per-sequence block tables. Static shapes everywhere: one
+  compiled program per (batch, max_pages) bucket, regardless of actual
+  sequence lengths.
+- **bf16 weights/activations, f32 softmax/norms** — MXU-friendly without
+  logit drift.
+- Sharding is NOT baked in here: ``parallel/sharding.py`` assigns
+  PartitionSpecs to this pytree by path (TP over heads/ffn), so the same
+  model code runs single-chip or pjit-sharded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from llmq_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
+from llmq_tpu.ops.norms import rms_norm
+from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    name: str = "llama3-tiny"
+    vocab_size: int = 512
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    ffn_dim: int = 256
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def llama3_tiny(**kw) -> LlamaConfig:
+    return replace(LlamaConfig(), **kw)
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    # Public Llama-3-8B architecture constants.
+    return replace(LlamaConfig(
+        name="llama3-8b", vocab_size=128256, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+        rope_theta=500000.0), **kw)
+
+
+def llama3_70b(**kw) -> LlamaConfig:
+    # Public Llama-3-70B architecture constants.
+    return replace(LlamaConfig(
+        name="llama3-70b", vocab_size=128256, dim=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, ffn_dim=28672, max_seq_len=8192,
+        rope_theta=500000.0), **kw)
+
+
+MODEL_CONFIGS = {
+    "llama3-tiny": llama3_tiny,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+}
+
+
+def get_config(name: str, **kw) -> LlamaConfig:
+    try:
+        return MODEL_CONFIGS[name](**kw)
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; known: {sorted(MODEL_CONFIGS)}")
+
+
+# -- parameters ---------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Random-init parameter pytree (stacked layers: leading dim L)."""
+    L, D, H, HKV, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.ffn_dim, cfg.vocab_size)
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 10)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    params: Params = {
+        "embed": norm_init(keys[0], (V, D), D),
+        "layers": {
+            "wq": norm_init(keys[1], (L, D, H * hd), D),
+            "wk": norm_init(keys[2], (L, D, HKV * hd), D),
+            "wv": norm_init(keys[3], (L, D, HKV * hd), D),
+            "wo": norm_init(keys[4], (L, H * hd, D), H * hd),
+            "w_gate": norm_init(keys[5], (L, D, F), D),
+            "w_up": norm_init(keys[6], (L, D, F), D),
+            "w_down": norm_init(keys[7], (L, F, D), F),
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(keys[8], (D, V), D)
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def init_kv_pages(cfg: LlamaConfig, num_pages: int, page_size: int,
+                  dtype: Optional[Any] = None) -> KVCache:
+    """Global paged KV pool: (L, P, page_size, H_kv, head_dim) per K/V.
+    Page 0 is reserved as the null/padding page."""
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+# -- forward ------------------------------------------------------------------
+
+def _mlp(h: jnp.ndarray, w_gate, w_up, w_down) -> jnp.ndarray:
+    """SwiGLU."""
+    g = jnp.dot(h, w_gate)
+    u = jnp.dot(h, w_up)
+    return jnp.dot(jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u, w_down)
+
+
+def _paged_write(pages: jnp.ndarray, values: jnp.ndarray,
+                 page_ids: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
+    """Scatter flat token KVs into the page pool.
+
+    pages: (P, page_size, H_kv, D); values: (N, H_kv, D);
+    page_ids/slots: (N,).
+    """
+    return pages.at[page_ids, slots].set(values)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_prefill(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,        # (B, T) int32, right-padded
+    positions: jnp.ndarray,     # (B, T) int32 absolute positions
+    lengths: jnp.ndarray,       # (B,) int32 — valid tokens per row
+    kv_cache: KVCache,          # paged pools (written in place via .at)
+    block_tables: jnp.ndarray,  # (B, max_pages) int32; pad with page 0
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Prefill: run up to T tokens per sequence, writing their KV into the
+    paged pool. Returns (logits (B, T, V) f32, updated cache).
+
+    Conventions (shared with the engine's KV allocator):
+    - **page 0 of the pool is reserved** — never allocated to a sequence;
+      padded tokens scatter their garbage KV there and padded block-table
+      entries point at it (masked out of attention by ``seq_lens``).
+    - supports continuation prefill (conversation turn 2+): ``positions``
+      carry absolute offsets; new tokens attend to the previously cached
+      pages through the same block tables.
+    """
+    B, T = tokens.shape
+    page_sz = kv_cache["k"].shape[2]
+    max_pages = block_tables.shape[1]
+    S = max_pages * page_sz
+
+    h = params["embed"][tokens].astype(cfg.dtype)  # (B, T, D)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)  # (B,T,half)
+
+    # Flat scatter coordinates for the paged write (same for every layer).
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])    # (B, T)
+    flat_valid = valid.reshape(-1)
+    flat_pos = positions.reshape(-1)                       # (B*T,)
+    page_of = jnp.where(
+        flat_valid,
+        block_tables[jnp.repeat(jnp.arange(B), T), flat_pos // page_sz],
+        0)                                                 # padding → page 0
+    slot_of = jnp.where(flat_valid, flat_pos % page_sz, 0)
+    # Absolute visible history per row: last valid position + 1.
+    last_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)
+    seq_lens = last_pos + 1                                # (B,)
+
+    def layer(h, xs):
+        (wq, wk, wv, wo, w_gate, w_up, w_down, attn_norm, mlp_norm,
+         k_pages, v_pages) = xs
+        hn = rms_norm(h, attn_norm, cfg.norm_eps)
+        q = jnp.dot(hn, wq).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.dot(hn, wk).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.dot(hn, wv).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # Write this layer's KV into its page pool.
+        k_pages = _paged_write(k_pages, k.reshape(-1, cfg.n_kv_heads, cfg.head_dim),
+                               page_of, slot_of)
+        v_pages = _paged_write(v_pages, v.reshape(-1, cfg.n_kv_heads, cfg.head_dim),
+                               page_of, slot_of)
+        # Attend over the full paged history (covers continuation turns);
+        # causality enforced via absolute positions.
+        k_hist = k_pages[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v_hist = v_pages[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        attn = _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens)
+        h = h + jnp.dot(attn.reshape(B, T, -1), wo)
+        hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
+        h = h + _mlp(hn2, w_gate, w_up, w_down)
+        return h, (k_pages, v_pages)
+
+    lp = params["layers"]
+    xs = (lp["wq"], lp["wk"], lp["wv"], lp["wo"], lp["w_gate"], lp["w_up"],
+          lp["w_down"], lp["attn_norm"], lp["mlp_norm"],
+          kv_cache["k"], kv_cache["v"])
+    h, (new_k, new_v) = lax.scan(layer, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    logits = (jnp.dot(h, head) if head is not None
+              else jnp.dot(h, params["embed"].T))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens):
+    """Causal attention of prefill queries over the paged history.
+
+    q: (B, T, H, D); k_hist/v_hist: (B, S, H_kv, D); positions: (B, T)
+    absolute; visibility: cache slot s belongs to absolute position s' —
+    by construction slot index IS the absolute position (block_tables map
+    position//page_size → page), so the mask is kv_pos <= q_pos and
+    kv_pos < seq_len.
+    """
+    B, T, H, D = q.shape
+    S = k_hist.shape[1]
+    n_rep = H // k_hist.shape[2]
+    k = jnp.repeat(k_hist, n_rep, axis=-2)
+    v = jnp.repeat(v_hist, n_rep, axis=-2)
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (D ** -0.5)
+    kv_pos = jnp.arange(S)[None, None, :]                  # (1,1,S)
+    mask = (kv_pos <= positions[:, :, None]) & (kv_pos < seq_lens[:, None, None])
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_decode(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,        # (B,) int32 — last generated token per seq
+    positions: jnp.ndarray,     # (B,) int32 — absolute position of `tokens`
+    kv_cache: KVCache,
+    block_tables: jnp.ndarray,  # (B, max_pages)
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One decode step for every active sequence. Returns
+    (logits (B, V) f32, updated cache)."""
+    B = tokens.shape[0]
+    page_sz = kv_cache["k"].shape[2]
+
+    h = params["embed"][tokens].astype(cfg.dtype)          # (B, D)
+    cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim,
+                            cfg.rope_theta)                # (B,1,half)
+    page_of = block_tables[jnp.arange(B), positions // page_sz]
+    slot_of = positions % page_sz
+    seq_lens = positions + 1
+
+    def layer(h, xs):
+        (wq, wk, wv, wo, w_gate, w_up, w_down, attn_norm, mlp_norm,
+         k_pages, v_pages) = xs
+        hn = rms_norm(h, attn_norm, cfg.norm_eps)
+        q = jnp.dot(hn, wq).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        k = jnp.dot(hn, wk).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.dot(hn, wv).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)[:, 0]                  # (B, H, D)
+        k = apply_rope(k, cos, sin)[:, 0]                  # (B, H_kv, D)
+        v = v[:, 0]
+        k_pages = k_pages.at[page_of, slot_of].set(k)
+        v_pages = v_pages.at[page_of, slot_of].set(v)
+        attn = paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                      seq_lens)            # (B, H, D)
+        h = h + jnp.dot(attn.reshape(B, -1), wo)
+        hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
+        h = h + _mlp(hn2, w_gate, w_up, w_down)
+        return h, (k_pages, v_pages)
+
+    lp = params["layers"]
+    xs = (lp["wq"], lp["wk"], lp["wv"], lp["wo"], lp["w_gate"], lp["w_up"],
+          lp["w_down"], lp["attn_norm"], lp["mlp_norm"],
+          kv_cache["k"], kv_cache["v"])
+    h, (new_k, new_v) = lax.scan(layer, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    logits = (jnp.dot(h, head) if head is not None
+              else jnp.dot(h, params["embed"].T))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
+            kv_cache: KVCache, block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy (used by the training step that
+    __graft_entry__.dryrun_multichip exercises over the device mesh)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    lengths = jnp.full((B,), T, jnp.int32)
+    logits, _ = forward_prefill(params, cfg, tokens, positions, lengths,
+                                kv_cache, block_tables)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()

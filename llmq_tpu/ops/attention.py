@@ -1,0 +1,84 @@
+"""Attention ops: causal prefill + paged decode (pure-JAX reference).
+
+The paged layout (BASELINE north star; PAPERS.md ragged paged attention)
+stores KV in fixed-size pages indexed by per-sequence block tables, so
+conversations of different lengths share one HBM pool with no per-request
+reallocation and no recompilation (static shapes throughout — XLA traces
+once per batch geometry bucket).
+
+The Pallas TPU kernel for the decode hot path lives in
+``ops/pallas/paged_attention.py``; this module is the semantics
+reference it is tested against, and the fallback on non-TPU backends.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: repeat KV heads to match query heads. (..., H_kv, D) → (..., H, D)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def causal_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                             *, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Causal self-attention for prefill.
+
+    q: (B, T, H, D); k, v: (B, S, H_kv, D) where S >= T (S may include a
+    previously-cached prefix; ``q_offset`` is the absolute position of
+    q's first token, scalar or per-batch (B,)).
+    Returns (B, T, H, D). Softmax in f32.
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    n_rep = H // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = D ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    q_pos = jnp.arange(T)[:, None] + jnp.asarray(q_offset).reshape(-1, 1, 1)  # (B|1,T,1)
+    kv_pos = jnp.arange(S)[None, None, :]
+    mask = kv_pos <= q_pos  # (B|1, T, S)
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,            # (B, H, D) — one new token per sequence
+    k_pages: jnp.ndarray,      # (P, page_size, H_kv, D) global page pool
+    v_pages: jnp.ndarray,      # (P, page_size, H_kv, D)
+    block_tables: jnp.ndarray,  # (B, max_pages) int32 page ids (pad = any valid id)
+    seq_lens: jnp.ndarray,     # (B,) int32 — tokens already in cache incl. current
+) -> jnp.ndarray:
+    """Single-token decode attention over the paged KV pool.
+
+    Gathers each sequence's pages via its block table, masks beyond
+    ``seq_lens`` and runs GQA attention. Returns (B, H, D).
+    """
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = block_tables.shape[1]
+    S = max_pages * page_size
+    # Gather: (B, max_pages, page_size, H_kv, D) → (B, S, H_kv, D)
+    k = k_pages[block_tables].reshape(B, S, -1, D)
+    v = v_pages[block_tables].reshape(B, S, -1, D)
+    n_rep = H // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = D ** -0.5
+    logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, :] < seq_lens[:, None]  # (B, S)
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

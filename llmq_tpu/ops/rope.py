@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE), Llama-3 convention.
+
+Llama-3 uses theta=500000 and rotates half-dimensions as (x1, x2) pairs
+split at head_dim/2 (the "GPT-NeoX" layout used by Meta's checkpoints
+after their permutation is undone — equivalent under a fixed basis
+change; we standardise on the split-half layout everywhere, including
+checkpoint import)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int,
+                 theta: float = 500000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given positions.
+
+    positions: (..., T) int32 → returns cos, sin of shape (..., T, head_dim//2),
+    computed in f32.
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate q or k.
+
+    x: (..., T, H, D); cos/sin: (..., T, D//2) broadcast over the head axis.
+    """
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c = cos[..., None, :]  # (..., T, 1, half) → broadcast across heads
+    s = sin[..., None, :]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

@@ -1,0 +1,29 @@
+"""Parallelism layer: device mesh, sharding rules, distributed init.
+
+New scope — the reference has NO parallelism or distributed communication
+backend (SURVEY.md §2: "no DP/TP/PP/SP/EP... no NCCL/MPI"); its
+"distribution" is HTTP between microservices. Here the TPU equivalents:
+
+- **TP** — tensor parallelism via GSPMD: PartitionSpecs over a named mesh
+  axis ("tp"), XLA inserts all-reduce/all-gather over ICI (the NCCL
+  analogue, compiler-emitted rather than hand-written).
+- **DP** — batch sharding over "dp".
+- **SP** — sequence/ring parallelism scaffolding over "sp"
+  (ops/ring_attention.py) for long-context.
+- **Multi-host** — ``jax.distributed.initialize`` + the same mesh spanning
+  hosts; DCN carries inter-host collectives (BASELINE config #5:
+  Llama-3-70B on a 2-host v5e-16).
+"""
+
+from llmq_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    single_device_mesh,
+    distributed_init,
+)
+from llmq_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    kv_cache_shardings,
+    param_shardings,
+    replicated,
+    shard_params,
+)

@@ -1,0 +1,66 @@
+"""Device mesh construction + multi-host init."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("mesh")
+
+
+def make_mesh(shape: Dict[str, int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({"dp": 1, "tp": 8})`` for a
+    v5e-8 TP-only serving mesh, or ``{"dp": 2, "tp": 8}`` over a 2-host
+    v5e-16. Axis sizes must multiply to the device count; an axis size of
+    -1 is inferred."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        if n % known:
+            raise ValueError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != n:
+        raise ValueError(
+            f"mesh shape {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {n}")
+    arr = np.array(devs).reshape(sizes)
+    mesh = Mesh(arr, axis_names=tuple(names))
+    log.info("mesh: %s over %d devices (%s)",
+             dict(zip(names, sizes)), n, devs[0].platform)
+    return mesh
+
+
+def single_device_mesh(axis_names: Sequence[str] = ("dp", "tp")) -> Mesh:
+    """A trivial mesh on one device — lets the same pjit code path run
+    unsharded on a single chip (BASELINE config #2)."""
+    dev = np.array(jax.devices()[:1]).reshape([1] * len(axis_names))
+    return Mesh(dev, axis_names=tuple(axis_names))
+
+
+def distributed_init(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize`` — the DCN-side
+    coordination service (role of MPI ranks / NCCL bootstrap in GPU
+    stacks). No-ops when already initialised or single-process."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+        log.info("jax.distributed initialised: process %d of %d",
+                 jax.process_index(), jax.process_count())
+    except RuntimeError as e:
+        log.info("jax.distributed not (re)initialised: %s", e)
