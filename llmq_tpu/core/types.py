@@ -213,13 +213,15 @@ class QueueStats:
     processing_count: int = 0
     completed_count: int = 0
     failed_count: int = 0
+    #: Pops that contributed to total_wait_time — the correct denominator
+    #: for the average (retried messages accumulate one wait per pop).
+    wait_samples: int = 0
     total_wait_time: float = 0.0
     total_process_time: float = 0.0
 
     @property
     def avg_wait_time(self) -> float:
-        done = self.completed_count + self.failed_count
-        return self.total_wait_time / done if done else 0.0
+        return self.total_wait_time / self.wait_samples if self.wait_samples else 0.0
 
     @property
     def avg_process_time(self) -> float:

@@ -44,23 +44,33 @@ def load_checkpoint(path: str, template: Optional[Params] = None) -> Params:
 
 # -- Hugging Face import ------------------------------------------------------
 
-def _permute_rope(w: np.ndarray, n_heads: int, dim_in: int) -> np.ndarray:
-    """Undo HF's rotary permutation so weights match our split-half RoPE.
+def _permute_meta_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Meta-original → split-half rotary layout for q/k projections.
 
-    HF stores q/k projections permuted for their interleaved rotary; our
-    apply_rope uses the split-half (NeoX) layout, which equals HF's
-    convention after this inverse permutation. w: (n_heads*head_dim, dim_in)
-    in HF (out, in) orientation."""
+    Meta's consolidated ``.pth`` checkpoints interleave rotary pairs as
+    (even, odd); our ``apply_rope`` (and HF safetensors) use the
+    split-half ("rotate_half") layout. This is the same permutation HF's
+    own conversion script applies. **HF safetensors checkpoints are
+    already split-half and must be loaded verbatim** — applying this to
+    them rotates wrong component pairs with wrong frequencies.
+    w: (n_heads*head_dim, dim_in) in (out, in) orientation."""
     head_dim = w.shape[0] // n_heads
-    w = w.reshape(n_heads, 2, head_dim // 2, dim_in)
+    dim_in = w.shape[1]
+    w = w.reshape(n_heads, head_dim // 2, 2, dim_in)
     w = w.transpose(0, 2, 1, 3).reshape(n_heads * head_dim, dim_in)
     return w
 
 
-def import_hf_llama(model_dir: str, cfg: LlamaConfig) -> Params:
+def import_hf_llama(model_dir: str, cfg: LlamaConfig,
+                    meta_rope_layout: bool = False) -> Params:
     """Convert a local Hugging Face Llama checkpoint directory
     (safetensors) into our stacked-layer pytree. Requires the
-    ``safetensors`` package (bundled with transformers)."""
+    ``safetensors`` package (bundled with transformers).
+
+    HF q/k projections are loaded verbatim: they are already in the
+    split-half rotary layout that ``ops/rope.apply_rope`` implements.
+    Pass ``meta_rope_layout=True`` only for safetensors re-exports of
+    Meta-original interleaved checkpoints."""
     from safetensors import safe_open  # type: ignore[import-not-found]
 
     files = sorted(f for f in os.listdir(model_dir)
@@ -92,9 +102,11 @@ def import_hf_llama(model_dir: str, cfg: LlamaConfig) -> Params:
         "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dt),
         "layers": {
             "wq": stack("model.layers.{i}.self_attn.q_proj.weight",
-                        lambda w: _permute_rope(w, cfg.n_heads, w.shape[1])),
+                        (lambda w: _permute_meta_rope(w, cfg.n_heads))
+                        if meta_rope_layout else None),
             "wk": stack("model.layers.{i}.self_attn.k_proj.weight",
-                        lambda w: _permute_rope(w, cfg.n_kv_heads, w.shape[1])),
+                        (lambda w: _permute_meta_rope(w, cfg.n_kv_heads))
+                        if meta_rope_layout else None),
             "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
             "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
             "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),

@@ -170,14 +170,25 @@ class NativeMLQ:
         return self._lib.mlq_requeue_accounting(self._h, name.encode())
 
     def stats(self, name: str) -> Tuple[int, List[int], List[float]]:
-        out_i = (ctypes.c_int64 * 4)()
+        out_i = (ctypes.c_int64 * 5)()
         out_d = (ctypes.c_double * 2)()
         err = self._lib.mlq_stats(self._h, name.encode(), out_i, out_d)
         return err, list(out_i), list(out_d)
 
     def queue_names(self) -> List[str]:
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.mlq_queue_names(self._h, buf, len(buf))
-        if n <= 0:
-            return []
-        return buf.value.decode().split("\n")
+        # Retry with a doubled buffer on ERR_FULL (overflow must not be
+        # folded into the empty case — that would silently drop every
+        # queue from queue_names/total_size/get_all_stats).
+        size = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.mlq_queue_names(self._h, buf, len(buf))
+            if n == ERR_FULL:
+                size *= 2
+                if size > (1 << 28):
+                    raise RuntimeError(
+                        "mlq_queue_names overflow: registry exceeds 256MB")
+                continue
+            if n <= 0:
+                return []
+            return buf.value.decode().split("\n")

@@ -50,7 +50,9 @@ class _PyBackend:
     def __init__(self) -> None:
         self._heaps: Dict[str, List[Tuple[int, int, int, float]]] = {}
         self._caps: Dict[str, int] = {}
-        self._stats: Dict[str, List[float]] = {}  # [pend, proc, comp, fail, wait, ptime]
+        # [pend, proc, comp, fail, pops, wait, ptime] — pops counts the
+        # wait samples feeding avg_wait (mirrors Stats in mlq.cpp).
+        self._stats: Dict[str, List[float]] = {}
         self._seq = itertools.count(1)
         self._mu = threading.Lock()
 
@@ -60,7 +62,7 @@ class _PyBackend:
                 return self.ERR_EXISTS
             self._heaps[name] = []
             self._caps[name] = capacity
-            self._stats[name] = [0, 0, 0, 0, 0.0, 0.0]
+            self._stats[name] = [0, 0, 0, 0, 0, 0.0, 0.0]
             return 0
 
     def remove_queue(self, name: str) -> int:
@@ -98,7 +100,8 @@ class _PyBackend:
             s = self._stats[name]
             s[0] -= 1
             s[1] += 1
-            s[4] += wait
+            s[4] += 1
+            s[5] += wait
             return 0, handle, wait
 
     def peek(self, name: str) -> Tuple[int, int]:
@@ -123,7 +126,8 @@ class _PyBackend:
             s = self._stats[name]
             s[0] -= 1
             s[1] += 1
-            s[4] += max(0.0, now - ts)
+            s[4] += 1
+            s[5] += max(0.0, now - ts)
             return 0
 
     def size(self, name: str) -> int:
@@ -139,7 +143,7 @@ class _PyBackend:
             if s[1] > 0:
                 s[1] -= 1
             s[2] += 1
-            s[5] += process_time
+            s[6] += process_time
             return 0
 
     def fail(self, name: str, process_time: float) -> int:
@@ -150,7 +154,7 @@ class _PyBackend:
             if s[1] > 0:
                 s[1] -= 1
             s[3] += 1
-            s[5] += process_time
+            s[6] += process_time
             return 0
 
     def requeue_accounting(self, name: str) -> int:
@@ -167,7 +171,7 @@ class _PyBackend:
             s = self._stats.get(name)
             if s is None:
                 return self.ERR_NOT_FOUND, [], []
-            return 0, [int(x) for x in s[:4]], list(s[4:])
+            return 0, [int(x) for x in s[:5]], list(s[5:])
 
     def queue_names(self) -> List[str]:
         with self._mu:
@@ -398,6 +402,7 @@ class MultiLevelQueue:
             processing_count=ints[1],
             completed_count=ints[2],
             failed_count=ints[3],
+            wait_samples=ints[4],
             total_wait_time=floats[0],
             total_process_time=floats[1],
         )

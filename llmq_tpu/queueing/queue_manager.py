@@ -88,6 +88,11 @@ class QueueManager:
             self.qconfig.enable_metrics if enable_metrics is None else enable_metrics)
         self._metrics = get_metrics() if self._metrics_enabled else None
         self._scale_callback = scale_callback
+        # Per-direction cooldown so neither an idle manager (perpetual
+        # "down") nor a workload flapping across both thresholds can spam
+        # the actuator: each direction fires at most once per cooldown,
+        # while the first crossing in a new direction stays prompt.
+        self._last_signal_ts: Dict[str, float] = {}
         self._stop = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
         # message.id → queue name, for complete/fail and API message lookup.
@@ -319,7 +324,11 @@ class QueueManager:
             signal = ScaleSignal(self.name, total, "down",
                                  {q: s.pending_count for q, s in stats.items()})
         if signal and self._scale_callback:
-            self._scale_callback(signal)
+            now = self._clock.now()
+            last = self._last_signal_ts.get(signal.direction, float("-inf"))
+            if now - last >= sc.cooldown:
+                self._last_signal_ts[signal.direction] = now
+                self._scale_callback(signal)
         return signal
 
     def _op_metric(self, op: str, status: str) -> None:

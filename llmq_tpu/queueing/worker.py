@@ -7,7 +7,11 @@ Parity with reference ``internal/priorityqueue/worker.go``:
   ``max_concurrent`` semaphore (worker.go:109-159)
 - per-message deadline from ``message.timeout`` (:166) — cooperative here:
   the :class:`ProcessContext` handed to the process function exposes
-  ``deadline``/``cancelled``; overruns are recorded as timeout failures
+  ``deadline``/``cancelled``. A process function that observes
+  ``ctx.expired()`` and wants the timeout/retry path MUST raise; a
+  successful return always completes the message (the overrun is still
+  counted in ``stats.timeouts``), because finished work must not be
+  discarded and re-executed
 - pluggable ``process_fn(ctx, message)`` — the execution seam where the
   TPU engine plugs in (:33; BASELINE north star)
 - failure → backoff + retry until ``max_retries`` (:202-239), then fail
@@ -262,12 +266,17 @@ class Worker:
             self.stats.total_process_time += elapsed
             if timed_out:
                 self.stats.timeouts += 1
-        if err is None and not timed_out:
+        if err is None:
+            # A successful return completes the message even when the
+            # deadline elapsed mid-flight (recorded in stats.timeouts
+            # above): the work — side effects, generated response — is
+            # done, and retrying would discard and re-execute it.
             self.manager.complete_message(msg, elapsed)
             with self.stats._mu:
                 self.stats.succeeded += 1
             return
-        reason = f"timeout after {elapsed:.3f}s" if timed_out and err is None else repr(err)
+        reason = (f"timeout after {elapsed:.3f}s ({err!r})" if timed_out
+                  else repr(err))
         self._handle_failure(msg, reason, elapsed, timed_out)
 
     # -- failure path (worker.go:202-239, properly wired) --------------------

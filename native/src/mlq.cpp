@@ -46,6 +46,10 @@ struct Stats {
   int64_t processing = 0;
   int64_t completed = 0;
   int64_t failed = 0;
+  // Pops that contributed to total_wait — the denominator for average
+  // wait (a message retried N times pops N times and accumulates N
+  // waits; dividing by completed+failed would skew the average).
+  int64_t pops = 0;
   double total_wait = 0.0;
   double total_process = 0.0;
 };
@@ -132,6 +136,7 @@ int64_t mlq_pop(void* h, const char* name, double now, uint64_t* out_handle,
   qq.heap.pop();
   qq.stats.pending -= 1;
   qq.stats.processing += 1;
+  qq.stats.pops += 1;
   qq.stats.total_wait += wait;
   return 0;
 }
@@ -154,6 +159,7 @@ int64_t mlq_pop_if(void* h, const char* name, uint64_t expected, double now) {
   qq.heap.pop();
   qq.stats.pending -= 1;
   qq.stats.processing += 1;
+  qq.stats.pops += 1;
   qq.stats.total_wait += wait;
   return 0;
 }
@@ -213,7 +219,8 @@ int64_t mlq_requeue_accounting(void* h, const char* name) {
   return 0;
 }
 
-// out_i: [pending, processing, completed, failed]; out_d: [total_wait, total_process]
+// out_i: [pending, processing, completed, failed, pops];
+// out_d: [total_wait, total_process]
 int64_t mlq_stats(void* h, const char* name, int64_t* out_i, double* out_d) {
   MLQ* q = static_cast<MLQ*>(h);
   std::lock_guard<std::mutex> lock(q->mu);
@@ -224,6 +231,7 @@ int64_t mlq_stats(void* h, const char* name, int64_t* out_i, double* out_d) {
   out_i[1] = s.processing;
   out_i[2] = s.completed;
   out_i[3] = s.failed;
+  out_i[4] = s.pops;
   out_d[0] = s.total_wait;
   out_d[1] = s.total_process;
   return 0;

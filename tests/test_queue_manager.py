@@ -122,6 +122,35 @@ class TestMonitor:
         sig = qm.run_monitor_once()
         assert sig is not None and sig.direction == "down"
 
+    def test_scale_signal_cooldown(self, fake_clock, queue_backend):
+        """An idle manager must not spam 'down' signals every tick — only
+        on edges (direction change) or after the cooldown."""
+        signals = []
+        cfg = default_config()
+        cfg.scheduler.scale_down_threshold = 10
+        cfg.scheduler.scale_up_threshold = 100
+        cfg.scheduler.cooldown = 60.0
+        qm = QueueManager("t", config=cfg, clock=fake_clock,
+                          backend=queue_backend, enable_metrics=False,
+                          scale_callback=signals.append)
+        for _ in range(5):
+            qm.run_monitor_once()
+            fake_clock.advance(1.0)
+        assert len(signals) == 1  # edge fired once, then suppressed
+        fake_clock.advance(60.0)
+        qm.run_monitor_once()
+        assert len(signals) == 2  # cooldown elapsed → re-fired
+        # First crossing in a new direction fires promptly (per-direction
+        # cooldown), but a flap back to "down" within cooldown does not.
+        for _ in range(100):
+            qm.push_message(Message())
+        qm.run_monitor_once()
+        assert len(signals) == 3 and signals[-1].direction == "up"
+        while qm.try_pop_message("normal"):
+            pass
+        qm.run_monitor_once()
+        assert len(signals) == 3  # "down" still cooling — no spam on flap
+
     def test_stale_cleanup_real(self, fake_clock, queue_backend):
         # Real version of the reference's stub (queue_manager.go:549-553).
         cfg = default_config()

@@ -136,12 +136,31 @@ class TestRetry:
 
 
 class TestTimeout:
-    def test_cooperative_timeout_marks_failure(self, fake_clock, queue_backend):
-        def slow(ctx, m):
-            # Simulates work overrunning the deadline on the fake clock.
+    def test_successful_overrun_completes(self, fake_clock, queue_backend):
+        """A process_fn that returns successfully after overrunning its
+        deadline keeps its completed work (recorded as a timeout stat) —
+        retrying would discard and re-execute finished work."""
+        def slow_but_done(ctx, m):
             fake_clock.advance(m.timeout + 1.0)
+            m.response = "done"
 
-        qm, dq, dlq, w = make_worker(fake_clock, queue_backend, slow,
+        qm, dq, dlq, w = make_worker(fake_clock, queue_backend,
+                                     slow_but_done, max_retries=0)
+        m = Message(timeout=5.0, max_retries=0)
+        qm.push_message(m)
+        w.process_batch()
+        assert m.status == MessageStatus.COMPLETED
+        assert m.response == "done"
+        assert w.stats.to_dict()["timeouts"] == 1
+        assert w.stats.to_dict()["succeeded"] == 1
+        assert dlq.size() == 0
+
+    def test_overrun_with_error_marks_timeout(self, fake_clock, queue_backend):
+        def slow_crash(ctx, m):
+            fake_clock.advance(m.timeout + 1.0)
+            raise RuntimeError("wedged decode step")
+
+        qm, dq, dlq, w = make_worker(fake_clock, queue_backend, slow_crash,
                                      max_retries=0)
         m = Message(timeout=5.0, max_retries=0)
         qm.push_message(m)
