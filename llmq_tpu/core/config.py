@@ -182,6 +182,11 @@ class ModelConfig:
     """Execution-plane model selection (new scope; BASELINE configs #2/#5)."""
     name: str = "llama3-tiny"          # llama3-tiny | llama3-8b | llama3-70b
     checkpoint_path: str = ""           # orbax checkpoint dir; empty → random init
+    tokenizer_path: str = ""            # local HF tokenizer dir; empty → bytes
+    # Safetensors re-exports of Meta-original interleaved-rotary
+    # checkpoints need the layout permutation (checkpoint.py); HF-native
+    # checkpoints must leave this False.
+    meta_rope_layout: bool = False
     dtype: str = "bfloat16"
     max_seq_len: int = 2048
     vocab_size: int = 0                 # 0 → model default

@@ -1,0 +1,31 @@
+"""Execution plane: continuous-batching engine over paged KV.
+
+Replaces the reference's external-HTTP-endpoint inference (and its
+simulated per-tier sleep, cmd/queue-manager/main.go:139-153) with an
+in-tree TPU executor behind the Worker's ProcessFunc seam."""
+
+from llmq_tpu.engine.engine import (
+    GenHandle,
+    GenRequest,
+    GenResult,
+    InferenceEngine,
+)
+from llmq_tpu.engine.executor import EchoExecutor, ExecutorSpec, JaxExecutor
+from llmq_tpu.engine.kv_allocator import PageAllocator
+from llmq_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer, get_tokenizer
+from llmq_tpu.engine.builder import build_engine
+
+__all__ = [
+    "ByteTokenizer",
+    "EchoExecutor",
+    "ExecutorSpec",
+    "GenHandle",
+    "GenRequest",
+    "GenResult",
+    "HFTokenizer",
+    "InferenceEngine",
+    "JaxExecutor",
+    "PageAllocator",
+    "build_engine",
+    "get_tokenizer",
+]

@@ -1,0 +1,92 @@
+"""Construct an engine from the typed config tree.
+
+The wiring used by the entrypoints (``python -m llmq_tpu serve``) and the
+benchmark harness: config → tokenizer + executor + engine, mirroring the
+component construction the reference spreads over its cmd/ binaries."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from llmq_tpu.core.config import Config
+from llmq_tpu.engine.engine import InferenceEngine
+from llmq_tpu.engine.executor import EchoExecutor, JaxExecutor
+from llmq_tpu.engine.tokenizer import get_tokenizer
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("engine.builder")
+
+
+def build_engine(cfg: Config, *, name: str = "engine0",
+                 params=None, warmup: bool = False,
+                 enable_metrics: Optional[bool] = None) -> InferenceEngine:
+    """Build the engine described by ``cfg.executor`` / ``cfg.model``.
+
+    ``backend="echo"`` needs no JAX at all (BASELINE config #1).
+    ``backend="jax"`` loads/initialises the model (checkpoint if
+    configured, else random init — fine for perf benches) and compiles
+    the decode program up front when ``warmup``.
+    """
+    ex = cfg.executor
+    tokenizer = get_tokenizer(getattr(cfg.model, "tokenizer_path", ""))
+    metrics_on = cfg.metrics.enabled if enable_metrics is None else enable_metrics
+
+    if ex.backend == "echo":
+        executor = EchoExecutor(
+            batch_size=ex.max_batch_size,
+            page_size=ex.page_size,
+            num_pages=ex.kv_pages,
+            max_pages_per_seq=max(
+                1, cfg.model.max_seq_len // ex.page_size),
+            eos_id=tokenizer.eos_id)
+    elif ex.backend == "jax":
+        import jax
+
+        from llmq_tpu.models.llama import get_config, init_params
+        from llmq_tpu.models.checkpoint import import_hf_llama, load_checkpoint
+
+        mcfg = get_config(cfg.model.name, max_seq_len=cfg.model.max_seq_len)
+        if cfg.model.vocab_size:
+            mcfg = get_config(cfg.model.name,
+                              max_seq_len=cfg.model.max_seq_len,
+                              vocab_size=cfg.model.vocab_size)
+        if tokenizer.vocab_size > mcfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({tokenizer.vocab_size}) exceeds model "
+                f"vocab ({mcfg.vocab_size}) — ids would silently clip and "
+                f"EOS could never be sampled; set model.vocab_size or pick "
+                f"a matching tokenizer")
+        if params is None:
+            path = cfg.model.checkpoint_path
+            if path and path.endswith(".safetensors.d"):
+                params = import_hf_llama(
+                    path, mcfg, meta_rope_layout=cfg.model.meta_rope_layout)
+            elif path:
+                try:
+                    params = load_checkpoint(path)
+                except Exception:
+                    log.exception("checkpoint load failed; random init")
+            if params is None:
+                params = init_params(jax.random.PRNGKey(0), mcfg)
+        executor = JaxExecutor(
+            mcfg, params,
+            batch_size=ex.max_batch_size,
+            page_size=ex.page_size,
+            num_pages=ex.kv_pages,
+            prefill_buckets=list(ex.prefill_buckets),
+            eos_id=tokenizer.eos_id)
+        if warmup:
+            executor.warmup()
+    else:
+        raise ValueError(f"unknown executor backend {ex.backend!r}")
+
+    engine = InferenceEngine(
+        executor, tokenizer,
+        name=name,
+        max_decode_steps=ex.max_decode_steps,
+        preemption=ex.preemption,
+        kv_pin_ttl=ex.kv_pin_ttl,
+        enable_metrics=metrics_on)
+    log.info("built %s engine %s (slots=%d pages=%d page_size=%d)",
+             ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size)
+    return engine

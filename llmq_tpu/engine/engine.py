@@ -1,0 +1,702 @@
+"""Continuous-batching inference engine.
+
+This is the component the reference stubs with a per-tier ``time.Sleep``
+(cmd/queue-manager/main.go:139-153) and the seam its Worker exposes as
+``ProcessFunc`` (internal/priorityqueue/worker.go:33): messages drained
+from the priority queues become generation requests; the engine packs
+them into a fixed set of decode slots and advances every active sequence
+one token per batched device step.
+
+Scheduling model (TPU-first):
+
+- **Fixed batch geometry.** One compiled decode program for
+  (batch_size, max_pages); admission/finish/preemption only permute which
+  sequence occupies which slot — nothing recompiles at runtime.
+- **Strict-priority admission with step-boundary preemption** (BASELINE
+  config #4): pending requests are served in (priority, arrival) order;
+  when no slot is free, an arriving request preempts the least-urgent
+  running sequence iff strictly more urgent. The preempted sequence keeps
+  its KV pages and resumes without re-prefill — preemption costs a slot
+  swap, not recomputation. (The reference's strict-priority poll,
+  cmd/queue-manager/main.go:112-124, can only reorder waiting messages;
+  it cannot displace running work.)
+- **Paged KV with conversation pinning** (BASELINE config #3): completed
+  conversations keep their pages resident (pinned via
+  :class:`PageAllocator`); the next turn prefills only its new tokens on
+  top of the cached KV (continuation prefill, models/llama.py).
+  Ownership is single-writer: admitting a conversation request *adopts*
+  the cached pages (the cache entry is removed); finishing re-caches
+  them. Pins are dropped by the conversation service's eviction
+  (``on_evict`` hook — one eviction policy for host state and HBM state,
+  state_manager.go:354-403), by the pin TTL, or by pool pressure (LRU).
+- **Pool-pressure shedding:** when pages run out, idle pinned
+  conversations are reclaimed LRU-first; if still short, the least
+  urgent running sequence is preempted *with* page release and later
+  resumes by re-prefilling prompt+generated (correct, slower — the
+  pathological case, bounded to the lowest tier).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.engine.executor import Executor
+from llmq_tpu.engine.kv_allocator import PageAllocator
+from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
+from llmq_tpu.metrics.registry import get_metrics
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("engine")
+
+
+@dataclass
+class GenRequest:
+    """One generation request (decoupled from the queue-plane Message so
+    the engine is usable as a plain library)."""
+
+    id: str
+    prompt: str
+    priority: Priority = Priority.NORMAL
+    conversation_id: str = ""
+    history_text: str = ""       # full-history fallback on conversation KV miss
+    max_new_tokens: int = 0      # 0 → engine default
+    temperature: float = 0.0
+
+    @classmethod
+    def from_message(cls, msg: Message) -> "GenRequest":
+        md = msg.metadata or {}
+        return cls(
+            id=msg.id,
+            prompt=msg.content,
+            priority=msg.priority,
+            conversation_id=msg.conversation_id,
+            history_text=str(md.get("history_text", "")),
+            max_new_tokens=int(md.get("max_new_tokens", 0) or 0),
+            temperature=float(md.get("temperature", 0.0) or 0.0),
+        )
+
+
+@dataclass
+class GenResult:
+    text: str = ""
+    tokens: List[int] = field(default_factory=list)
+    prompt_tokens: int = 0
+    cached_tokens: int = 0       # KV reused from the conversation cache
+    finish_reason: str = ""      # eos | length | cancelled | error
+    error: str = ""
+
+
+class GenHandle:
+    """Caller-side future for a submitted request."""
+
+    def __init__(self, request: GenRequest) -> None:
+        self.request = request
+        self.result: Optional[GenResult] = None
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def _finish(self, result: GenResult) -> None:
+        self.result = result
+        self._done.set()
+
+
+class _Sequence:
+    """Engine-internal state of one admitted request."""
+
+    __slots__ = ("req", "handle", "prompt_ids", "generated", "pages",
+                 "block_table", "pos", "cached_len", "last_token", "slot",
+                 "prefilled", "order", "adopted", "prefill_ids",
+                 "prefill_start", "carry")
+
+    def __init__(self, req: GenRequest, handle: GenHandle, order: int,
+                 max_pages: int) -> None:
+        self.req = req
+        self.handle = handle
+        self.order = order
+        self.prompt_ids: List[int] = []
+        self.generated: List[int] = []   # sampled output tokens (no EOS)
+        self.pages: List[int] = []
+        self.block_table = np.zeros(max_pages, np.int32)
+        self.pos = 0              # tokens whose KV is written
+        self.cached_len = 0       # prefix reused from conversation cache
+        self.last_token = 0       # most recent sampled token (next decode input)
+        self.slot: Optional[int] = None
+        self.prefilled = False
+        self.adopted = False      # conversation cache adoption attempted
+        self.prefill_ids: List[int] = []  # what prefill saw (for resume)
+        self.prefill_start = 0
+        self.carry: List[int] = []        # cache's pending token (see _ConvKV)
+
+    def sort_key(self):
+        return (int(self.req.priority), self.order)
+
+
+@dataclass
+class _ConvKV:
+    """A conversation's KV kept resident in HBM between turns."""
+
+    pages: List[int]
+    block_table: np.ndarray
+    length: int                  # tokens cached
+    last_used: float
+    #: On a "length" finish the final sampled token never went through a
+    #: decode step, so its KV is absent — the next turn must prefill it
+    #: first or the cached history silently misses one token.
+    pending: Optional[int] = None
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        executor: Executor,
+        tokenizer: Optional[Tokenizer] = None,
+        *,
+        name: str = "engine0",
+        max_decode_steps: int = 256,
+        preemption: bool = True,
+        kv_pin_ttl: float = 600.0,
+        enable_metrics: bool = True,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.executor = executor
+        self.spec = executor.spec
+        self.tokenizer = tokenizer or get_tokenizer()
+        self.name = name
+        self.max_decode_steps = max_decode_steps
+        self.preemption_enabled = preemption
+        self.kv_pin_ttl = kv_pin_ttl
+        self._clock = clock or SYSTEM_CLOCK
+        self._metrics = get_metrics() if enable_metrics else None
+
+        self.allocator = PageAllocator(self.spec.num_pages,
+                                       self.spec.page_size)
+        self._slots: List[Optional[_Sequence]] = [None] * self.spec.batch_size
+        self._pending: List = []           # heap of (prio, order, _Sequence)
+        self._inbox: List[_Sequence] = []  # submitted, not yet in heap
+        self._conv_cache: Dict[str, _ConvKV] = {}
+        self._conv_busy: Dict[str, int] = {}    # conv id → holder seq.order
+        self._conv_drop_pending: set = set()    # dropped while busy
+        self._order = itertools.count()
+        self._mu = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> GenHandle:
+        handle = GenHandle(req)
+        seq = _Sequence(req, handle, next(self._order),
+                        self.spec.max_pages_per_seq)
+        with self._mu:
+            self._inbox.append(seq)
+        self._wake.set()
+        return handle
+
+    def generate(self, prompt: str, *, max_new_tokens: int = 0,
+                 temperature: float = 0.0, conversation_id: str = "",
+                 priority: Priority = Priority.NORMAL,
+                 timeout: Optional[float] = 120.0) -> GenResult:
+        """Synchronous convenience: submit + wait (engine loop must be
+        running, or stepped by another thread)."""
+        h = self.submit(GenRequest(
+            id=f"gen-{next(self._order)}", prompt=prompt,
+            priority=priority, conversation_id=conversation_id,
+            max_new_tokens=max_new_tokens, temperature=temperature))
+        if not h.wait(timeout):
+            h.cancel()
+            raise TimeoutError("generate timed out")
+        assert h.result is not None
+        if h.result.finish_reason == "error":
+            raise RuntimeError(h.result.error)
+        return h.result
+
+    # -- worker seam (reference worker.go:33 ProcessFunc) --------------------
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        """Plug into queueing.Worker: fills the execution seam the
+        reference leaves to an HTTP endpoint. Blocks until the engine
+        finishes the message (honoring the worker's deadline)."""
+        req = GenRequest.from_message(msg)
+        handle = self.submit(req)
+        timeout = ctx.remaining() if ctx is not None else None
+        if not handle.wait(timeout):
+            handle.cancel()
+            raise TimeoutError(
+                f"engine did not finish message {msg.id} before deadline")
+        res = handle.result
+        assert res is not None
+        if res.finish_reason == "error":
+            raise RuntimeError(res.error)
+        if res.finish_reason == "cancelled":
+            raise RuntimeError("request cancelled")
+        msg.response = res.text
+        msg.metadata["usage"] = {
+            "prompt_tokens": res.prompt_tokens,
+            "cached_tokens": res.cached_tokens,
+            "completion_tokens": len(res.tokens),
+            "finish_reason": res.finish_reason,
+        }
+
+    # -- conversation service hooks (BASELINE config #3) ---------------------
+
+    def attach_conversation_manager(self, state_manager) -> None:
+        """Tie KV pin lifetime to the conversation service: touches
+        refresh the pin, evictions free the pages — the executor-side
+        registration the conversation service's on_touch/on_evict hooks
+        exist for."""
+        state_manager.on_touch(lambda conv: self.touch_conversation(conv.id))
+        state_manager.on_evict(lambda conv: self.drop_conversation(conv.id))
+
+    def touch_conversation(self, conv_id: str) -> None:
+        with self._mu:
+            kv = self._conv_cache.get(conv_id)
+            if kv is not None:
+                kv.last_used = self._clock.now()
+
+    def drop_conversation(self, conv_id: str) -> None:
+        with self._mu:
+            self._drop_conversation_locked(conv_id)
+
+    def _drop_conversation_locked(self, conv_id: str) -> None:
+        kv = self._conv_cache.pop(conv_id, None)
+        if kv is not None:
+            self.allocator.unpin(conv_id)
+            self.allocator.free(kv.pages)
+        elif conv_id in self._conv_busy:
+            # An active sequence owns the pages; don't re-cache at finish.
+            self._conv_drop_pending.add(conv_id)
+
+    def cached_conversations(self) -> List[str]:
+        with self._mu:
+            return list(self._conv_cache)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"engine-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                did_work = self.step()
+            except Exception:  # noqa: BLE001
+                log.exception("engine step failed")
+                did_work = False
+            if not did_work:
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    # -- core step -----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: admit / preempt, one batched decode step,
+        finish sequences. Returns True if any work happened. Single
+        stepper at a time — either the engine thread or a test/bench
+        driving it synchronously."""
+        self._ingest()
+        self._expire_pins()
+        admitted = self._admit()
+        stepped = self._decode_once()
+        return admitted or stepped
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            did = self.step()
+            if not did:
+                with self._mu:
+                    idle = not self._inbox and not self._pending and all(
+                        s is None for s in self._slots)
+                if idle:
+                    return
+        raise RuntimeError("engine did not go idle")
+
+    # -- internals -----------------------------------------------------------
+
+    def _ingest(self) -> None:
+        with self._mu:
+            newly, self._inbox = self._inbox, []
+        for seq in newly:
+            heapq.heappush(self._pending,
+                           (int(seq.req.priority), seq.order, seq))
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _least_urgent_active(
+            self, exclude: Optional[_Sequence] = None) -> Optional[_Sequence]:
+        worst: Optional[_Sequence] = None
+        for s in self._slots:
+            if s is None or s is exclude:
+                continue
+            if worst is None or s.sort_key() > worst.sort_key():
+                worst = s
+        return worst
+
+    def _admit(self) -> bool:
+        admitted = False
+        while self._pending:
+            prio, order, seq = self._pending[0]
+            if seq.handle.cancelled:
+                heapq.heappop(self._pending)
+                self._finish(seq, "cancelled")
+                continue
+            conv = seq.req.conversation_id
+            if conv:
+                holder = self._conv_busy.get(conv)
+                if holder is not None and holder != seq.order:
+                    # One live sequence per conversation (turn ordering):
+                    # strict-priority head-of-line wait.
+                    break
+            slot = self._free_slot()
+            if slot is None and self.preemption_enabled:
+                victim = self._least_urgent_active()
+                if victim is not None and victim.sort_key() > (prio, order):
+                    self._preempt(victim, release_pages=False)
+                    slot = self._free_slot()
+            if slot is None:
+                break
+            heapq.heappop(self._pending)
+            if not self._start_sequence(seq, slot):
+                # Could not get pages even after shedding: push back and
+                # stop admitting this round.
+                heapq.heappush(self._pending, (prio, order, seq))
+                break
+            admitted = True
+        return admitted
+
+    def _preempt(self, victim: _Sequence, release_pages: bool) -> None:
+        """Step-boundary preemption: the victim's slot is handed over; its
+        KV pages stay resident (cheap resume) unless the pool itself is
+        the contended resource, in which case it later resumes by
+        re-prefilling prompt + generated-so-far."""
+        assert victim.slot is not None
+        self._slots[victim.slot] = None
+        self.executor.release_slot(victim.slot)
+        victim.slot = None
+        if release_pages:
+            self.allocator.free(victim.pages)
+            victim.pages = []
+            victim.block_table[:] = 0
+            victim.pos = 0
+            victim.cached_len = 0
+            victim.prefilled = False
+        heapq.heappush(self._pending,
+                       (int(victim.req.priority), victim.order, victim))
+        if self._metrics:
+            self._metrics.preemptions.labels(
+                self.name, victim.req.priority.tier_name).inc()
+        log.info("preempted %s (%s)%s", victim.req.id,
+                 victim.req.priority.tier_name,
+                 " releasing pages" if release_pages else "")
+
+    def _reclaim_idle_conversation(self) -> bool:
+        """LRU-evict one idle pinned conversation to relieve pool
+        pressure. Returns True if pages were freed."""
+        with self._mu:
+            if not self._conv_cache:
+                return False
+            cid = min(self._conv_cache,
+                      key=lambda c: self._conv_cache[c].last_used)
+            self._drop_conversation_locked(cid)
+        log.info("evicted conversation KV %s under pool pressure", cid)
+        return True
+
+    def _alloc_pages(self, n: int,
+                     protect: Optional[_Sequence] = None) -> Optional[List[int]]:
+        """Allocate with shedding: idle conversation KV first, then
+        preempt-with-release of the least urgent runner (never
+        ``protect``)."""
+        while True:
+            pages = self.allocator.alloc(n)
+            if pages is not None:
+                return pages
+            if self._reclaim_idle_conversation():
+                continue
+            victim = self._least_urgent_active(exclude=protect)
+            if victim is not None and self.preemption_enabled:
+                self._preempt(victim, release_pages=True)
+                continue
+            return None
+
+    def _start_sequence(self, seq: _Sequence, slot: int) -> bool:
+        """Admit ``seq`` into ``slot``. Returns False only when pages are
+        unavailable (seq stays pending). May finish the sequence
+        immediately (EOS on prefill / capacity error)."""
+        req = seq.req
+        conv = req.conversation_id
+        if not seq.prefilled:
+            # Adopt the conversation's cached KV exactly once (single
+            # ownership: the cache entry moves into this sequence).
+            if conv and not seq.adopted:
+                with self._mu:
+                    kv = self._conv_cache.pop(conv, None)
+                    if kv is not None:
+                        self.allocator.unpin(conv)
+                    self._conv_busy[conv] = seq.order
+                seq.adopted = True
+                if kv is not None:
+                    seq.cached_len = kv.length
+                    seq.pos = kv.length
+                    seq.block_table[:] = kv.block_table
+                    seq.pages = list(kv.pages)
+                    if kv.pending is not None:
+                        seq.carry = [kv.pending]
+            if not seq.prompt_ids:
+                text = req.prompt
+                if seq.cached_len == 0 and req.history_text:
+                    text = req.history_text + req.prompt
+                ids = self.tokenizer.encode(text)
+                seq.prompt_ids = ids or [self.tokenizer.bos_id]
+
+            start_pos = seq.cached_len
+            # KV to (re)build: prompt plus all previously sampled tokens
+            # except the newest (whose KV is written by its decode step).
+            resume_last: Optional[int] = None
+            ids = seq.carry + seq.prompt_ids
+            if seq.generated:
+                ids = ids + seq.generated[:-1]
+                resume_last = seq.generated[-1]
+
+            capacity = self.spec.max_pages_per_seq * self.spec.page_size
+            if start_pos + len(ids) + 1 > capacity:
+                keep = capacity - start_pos - max(
+                    1, min(self.max_decode_steps, capacity // 4))
+                if keep < 1:
+                    self._finish(seq, "error",
+                                 "prompt exceeds KV capacity")
+                    return True
+                ids = ids[-keep:]
+            have = len(seq.pages)
+            need = PageAllocator.pages_for(
+                start_pos + len(ids) + 1, self.spec.page_size) - have
+            if need > self.allocator.total:
+                self._finish(seq, "error",
+                             f"request needs {need} pages; pool has "
+                             f"{self.allocator.total}")
+                return True
+            if need > 0:
+                pages = self._alloc_pages(need, protect=None)
+                if pages is None:
+                    return False
+                seq.block_table[have:have + need] = pages
+                seq.pages.extend(pages)
+
+            first = self.executor.prefill(ids, start_pos, seq.block_table,
+                                          req.temperature, slot)
+            seq.pos = start_pos + len(ids)
+            seq.prefill_ids = ids
+            seq.prefill_start = start_pos
+            seq.prefilled = True
+            seq.slot = slot
+            self._slots[slot] = seq
+            if resume_last is not None:
+                seq.last_token = resume_last
+                return True
+            if first == self.spec.eos_id:
+                self._finish_active(seq, "eos")
+                return True
+            seq.generated.append(first)
+            seq.last_token = first
+            if self._metrics:
+                self._metrics.generated_tokens.labels(
+                    self.name, req.priority.tier_name).inc()
+            limit = req.max_new_tokens or self.max_decode_steps
+            if len(seq.generated) >= limit:
+                self._finish_active(seq, "length")
+            return True
+        # Resuming a slot-only preemption: KV intact, just take the slot
+        # (per-slot-state executors re-register their context).
+        self.executor.resume(slot, seq.prefill_ids, seq.prefill_start)
+        seq.slot = slot
+        self._slots[slot] = seq
+        return True
+
+    def _ensure_decode_page(self, seq: _Sequence) -> bool:
+        """The next decode step writes KV at ``seq.pos`` — make sure a
+        page backs it."""
+        idx = seq.pos // self.spec.page_size
+        if idx < len(seq.pages):
+            return True
+        pages = self._alloc_pages(1, protect=seq)
+        if pages is None:
+            return False
+        seq.block_table[len(seq.pages)] = pages[0]
+        seq.pages.extend(pages)
+        return True
+
+    def _decode_once(self) -> bool:
+        B = self.spec.batch_size
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            self._set_gauges()
+            return False
+        for seq in list(active):
+            if seq.handle.cancelled:
+                self._finish_active(seq, "cancelled")
+            elif seq.pos // self.spec.page_size >= self.spec.max_pages_per_seq:
+                self._finish_active(seq, "length")  # block table exhausted
+            elif not self._ensure_decode_page(seq):
+                # Pool exhausted even after shedding everyone else:
+                # requeue this one rather than truncating its output.
+                self._preempt(seq, release_pages=True)
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            self._set_gauges()
+            return False
+
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
+        temps = np.zeros(B, np.float32)
+        for seq in active:
+            i = seq.slot
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            block_tables[i] = seq.block_table
+            temps[i] = seq.req.temperature
+        out = self.executor.decode(tokens, positions, block_tables, temps)
+        self.steps += 1
+        if self._metrics:
+            self._metrics.decode_steps.labels(self.name).inc()
+        for seq in active:
+            nxt = int(out[seq.slot])
+            seq.pos += 1          # last_token's KV is now written
+            self._commit_token(seq, nxt)
+        self._set_gauges()
+        return True
+
+    def _commit_token(self, seq: _Sequence, nxt: int) -> None:
+        if nxt == self.spec.eos_id:
+            self._finish_active(seq, "eos")
+            return
+        seq.generated.append(nxt)
+        seq.last_token = nxt
+        if self._metrics:
+            self._metrics.generated_tokens.labels(
+                self.name, seq.req.priority.tier_name).inc()
+        limit = seq.req.max_new_tokens or self.max_decode_steps
+        if len(seq.generated) >= limit:
+            self._finish_active(seq, "length")
+
+    def _finish_active(self, seq: _Sequence, reason: str) -> None:
+        if seq.slot is not None:
+            self.executor.release_slot(seq.slot)
+            self._slots[seq.slot] = None
+            seq.slot = None
+        conv = seq.req.conversation_id
+        if conv and reason in ("eos", "length"):
+            with self._mu:
+                if conv in self._conv_drop_pending:
+                    self._conv_drop_pending.discard(conv)
+                    self.allocator.free(seq.pages)
+                else:
+                    self._conv_cache[conv] = _ConvKV(
+                        pages=list(seq.pages),
+                        block_table=seq.block_table.copy(),
+                        length=seq.pos,
+                        last_used=self._clock.now(),
+                        pending=(seq.last_token if reason == "length"
+                                 else None))
+                    self.allocator.pin(conv, seq.pages)
+            seq.pages = []
+        self._finish(seq, reason)
+
+    def _finish(self, seq: _Sequence, reason: str, error: str = "") -> None:
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+        conv = seq.req.conversation_id
+        if conv:
+            with self._mu:
+                if self._conv_busy.get(conv) == seq.order:
+                    del self._conv_busy[conv]
+                self._conv_drop_pending.discard(conv)
+        res = GenResult(
+            text=self.tokenizer.decode(seq.generated),
+            tokens=list(seq.generated),
+            prompt_tokens=len(seq.prompt_ids),
+            cached_tokens=seq.cached_len,
+            finish_reason=reason,
+            error=error)
+        seq.handle._finish(res)
+
+    def _expire_pins(self) -> None:
+        if self.kv_pin_ttl <= 0:
+            return
+        now = self._clock.now()
+        with self._mu:
+            stale = [cid for cid, kv in self._conv_cache.items()
+                     if now - kv.last_used > self.kv_pin_ttl]
+            for cid in stale:
+                self._drop_conversation_locked(cid)
+
+    def _set_gauges(self) -> None:
+        if not self._metrics:
+            return
+        self._metrics.kv_pages_in_use.labels(self.name).set(
+            self.allocator.used())
+        self._metrics.kv_pinned_conversations.labels(self.name).set(
+            len(self._conv_cache))
+        self._metrics.batch_occupancy.labels(self.name).set(
+            sum(1 for s in self._slots if s is not None))
+
+    # -- stats ---------------------------------------------------------------
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            pending = len(self._pending) + len(self._inbox)
+            cached = len(self._conv_cache)
+        return {
+            "name": self.name,
+            "slots": self.spec.batch_size,
+            "active": sum(1 for s in self._slots if s is not None),
+            "pending": pending,
+            "decode_steps": self.steps,
+            "kv_pages_used": self.allocator.used(),
+            "kv_pages_total": self.allocator.total,
+            "cached_conversations": cached,
+        }

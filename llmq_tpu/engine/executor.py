@@ -1,0 +1,280 @@
+"""Execution backends for the continuous-batching engine.
+
+The engine (engine.py) owns scheduling — slots, admission, preemption,
+page tables; an :class:`Executor` owns compute — prefill a prompt's KV and
+produce the first token, then advance every active slot one token per
+decode step. Two backends:
+
+- :class:`EchoExecutor` — deterministic, JAX-free: "generates" the prompt
+  back. BASELINE config #1's mock LLM endpoint, and the queue-plane
+  benchmark backend (replaces the reference's simulated per-tier sleep,
+  cmd/queue-manager/main.go:139-153, with actual instant compute).
+- :class:`JaxExecutor` — the TPU path (BASELINE configs #2/#3/#5): paged
+  KV pool in device memory, bucketed prefill (one compile per bucket),
+  one fixed-geometry jitted decode step for the whole batch with the KV
+  pool **donated** so XLA updates it in place instead of copying the pool
+  every step, and in-jit sampling so only (B,) token ids cross back to
+  the host per step.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Protocol
+
+import numpy as np
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("executor")
+
+
+@dataclass(frozen=True)
+class ExecutorSpec:
+    """Geometry the engine schedules against."""
+
+    batch_size: int          # decode slots
+    page_size: int           # tokens per KV page
+    num_pages: int           # total pool pages (page 0 reserved)
+    max_pages_per_seq: int   # block-table width
+    eos_id: int
+
+
+class Executor(Protocol):
+    spec: ExecutorSpec
+
+    def prefill(self, tokens: List[int], start_pos: int,
+                block_table: np.ndarray, temperature: float,
+                slot: int) -> int:
+        """Write ``tokens``' KV at absolute positions
+        ``[start_pos, start_pos+len)`` through ``block_table`` and return
+        the first sampled next token."""
+        ...
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               block_tables: np.ndarray,
+               temperatures: np.ndarray) -> np.ndarray:
+        """One batched decode step. All arrays are full batch-size; the
+        engine ignores outputs of inactive slots (their rows point at
+        page 0). Returns (B,) next tokens."""
+        ...
+
+    def release_slot(self, slot: int) -> None:
+        """Slot freed by the engine (sequence finished or preempted)."""
+        ...
+
+    def resume(self, slot: int, tokens: List[int], start_pos: int) -> None:
+        """A previously-prefilled sequence re-enters ``slot`` after a
+        slot-only preemption (its KV pages are intact, no re-prefill).
+        ``tokens``/``start_pos`` are what its prefill saw. Stateless
+        backends ignore this; per-slot-state backends re-register."""
+        ...
+
+
+# -- echo ----------------------------------------------------------------------
+
+
+class EchoExecutor:
+    """Echoes the prompt: token i of the response is prompt token i; after
+    the full prompt, EOS. No device, no KV reads — but the engine still
+    drives the full slot/page machinery against it."""
+
+    def __init__(self, batch_size: int = 8, page_size: int = 16,
+                 num_pages: int = 512, max_pages_per_seq: int = 32,
+                 eos_id: int = 2) -> None:
+        self.spec = ExecutorSpec(batch_size, page_size, num_pages,
+                                 max_pages_per_seq, eos_id)
+        self._slot_prompt: Dict[int, List[int]] = {}
+        self._slot_end: Dict[int, int] = {}   # absolute pos after prompt
+        self._mu = threading.Lock()
+
+    def prefill(self, tokens: List[int], start_pos: int,
+                block_table: np.ndarray, temperature: float,
+                slot: int) -> int:
+        with self._mu:
+            self._slot_prompt[slot] = list(tokens)
+            self._slot_end[slot] = start_pos + len(tokens)
+        return tokens[0] if tokens else self.spec.eos_id
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               block_tables: np.ndarray,
+               temperatures: np.ndarray) -> np.ndarray:
+        out = np.full(self.spec.batch_size, self.spec.eos_id, np.int32)
+        with self._mu:
+            for slot, prompt in self._slot_prompt.items():
+                # positions[slot] is the absolute position of the last
+                # emitted token; k is its index in the echo stream.
+                k = int(positions[slot]) - self._slot_end[slot]
+                nxt = k + 1
+                if 0 <= nxt < len(prompt):
+                    out[slot] = prompt[nxt]
+        return out
+
+    def release_slot(self, slot: int) -> None:
+        with self._mu:
+            self._slot_prompt.pop(slot, None)
+            self._slot_end.pop(slot, None)
+
+    def resume(self, slot: int, tokens: List[int], start_pos: int) -> None:
+        with self._mu:
+            self._slot_prompt[slot] = list(tokens)
+            self._slot_end[slot] = start_pos + len(tokens)
+
+
+# -- JAX ----------------------------------------------------------------------
+
+
+class JaxExecutor:
+    """Paged continuous-batching executor over models/llama.py.
+
+    Compilation surface is bounded by design: one decode program for the
+    fixed (B, max_pages) geometry, and one prefill program per length
+    bucket (``prefill_buckets``); prompts longer than the largest bucket
+    stream through it in chunks (continuation prefill over the same block
+    table). The KV pool is donated through every call, so the working set
+    stays at one pool (plus transient activations) in HBM.
+    """
+
+    def __init__(self, model_cfg, params, *, batch_size: int = 8,
+                 page_size: int = 16, num_pages: int = 512,
+                 prefill_buckets: Optional[List[int]] = None,
+                 top_k: int = 0, top_p: float = 1.0, eos_id: int = 2,
+                 cache_dtype=None, seed: int = 0) -> None:
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from llmq_tpu.models.llama import (
+            forward_decode, forward_prefill, init_kv_pages)
+        from llmq_tpu.ops.sampling import sample_token
+
+        self._jax = jax
+        self._jnp = jnp
+        self.model_cfg = model_cfg
+        self.params = params
+        max_pages_per_seq = max(
+            1, model_cfg.max_seq_len // page_size)
+        self.spec = ExecutorSpec(batch_size, page_size, num_pages,
+                                 max_pages_per_seq, eos_id)
+        self.prefill_buckets = sorted(prefill_buckets or [32, 128, 512])
+        self.cache = init_kv_pages(model_cfg, num_pages, page_size,
+                                   dtype=cache_dtype)
+        self._key = jax.random.PRNGKey(seed)
+
+        cfg = model_cfg
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _prefill_step(params, cache, tokens, positions, lengths,
+                          block_tables):
+            logits, cache = forward_prefill(
+                params, cfg, tokens, positions, lengths, cache, block_tables)
+            last = logits[0, lengths[0] - 1]  # (V,) f32
+            return last, cache
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_step(params, cache, tokens, positions, block_tables,
+                         temperatures, key):
+            logits, cache = forward_decode(
+                params, cfg, tokens, positions, cache, block_tables)
+            toks = sample_token(logits, key, temperature=temperatures,
+                                top_k=top_k, top_p=top_p)
+            return toks, cache
+
+        self._prefill_step = _prefill_step
+        self._decode_step = _decode_step
+
+    # -- helpers -------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _next_key(self):
+        self._key, sub = self._jax.random.split(self._key)
+        return sub
+
+    def warmup(self) -> None:
+        """Compile the decode step and every prefill bucket up front
+        (the reference has no analogue; SURVEY §7 'warmup at startup')."""
+        spec = self.spec
+        bt = np.zeros((1, spec.max_pages_per_seq), np.int32)
+        for b in self.prefill_buckets:
+            self.prefill([1] * min(b, 2), 0, bt[0], 0.0, 0)
+        # Reset pool: warmup wrote garbage KV into page 0 only (block
+        # table all-zero), which is never read — nothing to clean.
+        self.decode(np.zeros(spec.batch_size, np.int32),
+                    np.zeros(spec.batch_size, np.int32),
+                    np.zeros((spec.batch_size, spec.max_pages_per_seq),
+                             np.int32),
+                    np.zeros(spec.batch_size, np.float32))
+
+    # -- Executor API --------------------------------------------------------
+
+    def prefill(self, tokens: List[int], start_pos: int,
+                block_table: np.ndarray, temperature: float,
+                slot: int) -> int:
+        jnp = self._jnp
+        spec = self.spec
+        bt = jnp.asarray(block_table, jnp.int32)[None, :]
+        pos = start_pos
+        remaining = list(tokens)
+        last_logits = None
+        while remaining:
+            chunk = remaining[: self.prefill_buckets[-1]]
+            remaining = remaining[len(chunk):]
+            T = self._bucket_for(len(chunk))
+            padded = np.zeros(T, np.int32)
+            padded[: len(chunk)] = chunk
+            positions = np.minimum(pos + np.arange(T), pos + len(chunk) - 1)
+            last_logits, self.cache = self._prefill_step(
+                self.params, self.cache,
+                jnp.asarray(padded)[None, :],
+                jnp.asarray(positions, jnp.int32)[None, :],
+                jnp.asarray([len(chunk)], jnp.int32),
+                bt)
+            pos += len(chunk)
+        if last_logits is None:
+            return spec.eos_id
+        logits = np.asarray(last_logits)
+        return int(_sample_host(logits, temperature, self._host_rng()))
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               block_tables: np.ndarray,
+               temperatures: np.ndarray) -> np.ndarray:
+        jnp = self._jnp
+        toks, self.cache = self._decode_step(
+            self.params, self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(temperatures, jnp.float32),
+            self._next_key())
+        return np.asarray(toks)
+
+    def release_slot(self, slot: int) -> None:
+        pass  # no per-slot host state
+
+    def resume(self, slot: int, tokens: List[int], start_pos: int) -> None:
+        pass  # block tables carry everything
+
+    _rng: Optional[np.random.Generator] = None
+
+    def _host_rng(self) -> np.random.Generator:
+        if self._rng is None:
+            self._rng = np.random.default_rng(1234)
+        return self._rng
+
+
+def _sample_host(logits: np.ndarray, temperature: float,
+                 rng: np.random.Generator) -> int:
+    """Host-side sampling for the single prefill logit vector (greedy when
+    temperature<=0). Decode-path sampling happens in-jit."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    z = (logits - logits.max()) / temperature
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))

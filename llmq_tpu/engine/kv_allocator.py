@@ -1,0 +1,98 @@
+"""Host-side allocator for the paged KV cache.
+
+The device pool is ``(L, P, page_size, H_kv, D)`` (models/llama.py
+``init_kv_pages``); this allocator owns the page-id space on the host:
+
+- **page 0 is reserved** as the null/padding page (llama.py's scatter
+  convention: padded tokens and padded block-table entries point at it);
+  it is never handed out.
+- free pages are a LIFO free list — O(1) alloc/free, and recently-freed
+  (cache-warm) pages are reused first.
+- all-or-nothing allocation: a request that cannot get every page it
+  needs gets none, so a half-admitted sequence never deadlocks the pool.
+
+The conversation KV pinning of BASELINE config #3 is accounted here via
+named pins: the engine pins a conversation's pages while its KV stays
+resident in HBM between turns, and unpins exactly when the conversation
+service evicts it (state_manager on_evict hook) or the pin TTL/pool
+pressure reclaims it — the HBM analogue of the reference's conversation
+TTL cleanup (state_manager.go:354-403).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class PageAllocator:
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # 1..P-1
+        self._pins: Dict[str, List[int]] = {}
+        self._mu = threading.Lock()
+
+    # -- allocation ----------------------------------------------------------
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, or None if the pool can't satisfy all of
+        them (all-or-nothing)."""
+        if n <= 0:
+            return []
+        with self._mu:
+            if len(self._free) < n:
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        with self._mu:
+            for p in pages:
+                if p <= 0 or p >= self.num_pages:
+                    raise ValueError(f"bad page id {p}")
+                self._free.append(p)
+
+    # -- conversation pins (BASELINE config #3) ------------------------------
+
+    def pin(self, key: str, pages: List[int]) -> None:
+        """Record ``pages`` as pinned for ``key`` (a conversation id).
+        Pinned pages are still owned by the caller — this is accounting,
+        used for metrics and so pool-pressure reclaim can find them."""
+        with self._mu:
+            self._pins[key] = list(pages)
+
+    def unpin(self, key: str) -> List[int]:
+        """Drop the pin and return its pages (caller decides to free or
+        hand them to an active sequence)."""
+        with self._mu:
+            return self._pins.pop(key, [])
+
+    def pinned_keys(self) -> List[str]:
+        with self._mu:
+            return list(self._pins)
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        """Allocatable pages (excludes reserved page 0)."""
+        return self.num_pages - 1
+
+    def available(self) -> int:
+        with self._mu:
+            return len(self._free)
+
+    def used(self) -> int:
+        return self.total - self.available()
+
+    def pinned_pages(self) -> int:
+        with self._mu:
+            return sum(len(p) for p in self._pins.values())
+
+    @staticmethod
+    def pages_for(tokens: int, page_size: int) -> int:
+        """Pages needed to hold ``tokens`` positions."""
+        return -(-tokens // page_size)

@@ -1,0 +1,72 @@
+"""Tokenizers for the execution plane.
+
+The reference has no tokenizer (inference happens behind external HTTP
+endpoints, SURVEY.md §2.2); the in-tree TPU engine needs text→tokens→text.
+Two implementations:
+
+- :class:`ByteTokenizer` — dependency-free UTF-8 byte tokenizer whose ids
+  fit any vocab ≥ 259. The default for tests, the echo executor, and
+  random-init models (BASELINE configs #1/#2 smoke paths).
+- :class:`HFTokenizer` — wraps a local Hugging Face tokenizer for real
+  Llama-3 checkpoints (BASELINE configs #2-#5). Import is gated so the
+  queue plane never depends on transformers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol
+
+
+class Tokenizer(Protocol):
+    pad_id: int
+    bos_id: int
+    eos_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> List[int]: ...
+
+    def decode(self, ids: List[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes shifted past 3 special ids (pad=0, bos=1, eos=2)."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _OFFSET = 3
+    vocab_size = 256 + _OFFSET
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self._OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - self._OFFSET for i in ids
+                     if i >= self._OFFSET and i < self.vocab_size)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Hugging Face tokenizer adapter (local files only; zero egress)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer  # gated import
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.pad_id = self._tok.pad_token_id or 0
+        self.bos_id = self._tok.bos_token_id or 1
+        self.eos_id = self._tok.eos_token_id or 2
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def get_tokenizer(path: str = "") -> Tokenizer:
+    """Tokenizer from config: a local HF path if given, else bytes."""
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -1,0 +1,417 @@
+"""Inference engine tests: allocator, tokenizer, echo end-to-end,
+JAX-executor correctness, conversation KV reuse, preemption, pool
+pressure, and the Worker process_fn seam.
+
+The engine replaces the reference's simulated LLM processing
+(cmd/queue-manager/main.go:139-153) behind the ProcessFunc seam
+(worker.go:33); these tests are the evidence the seam is actually filled."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from llmq_tpu.core.clock import FakeClock
+from llmq_tpu.core.types import Message, MessageStatus, Priority
+from llmq_tpu.engine import (
+    ByteTokenizer,
+    EchoExecutor,
+    GenRequest,
+    InferenceEngine,
+    JaxExecutor,
+    PageAllocator,
+)
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        t = ByteTokenizer()
+        for text in ("hello", "héllo wörld", "日本語", ""):
+            assert t.decode(t.encode(text)) == text
+
+    def test_ids_above_specials(self):
+        t = ByteTokenizer()
+        ids = t.encode("abc")
+        assert all(i >= 3 for i in ids)
+        assert t.vocab_size == 259
+
+
+# -- allocator ----------------------------------------------------------------
+
+class TestPageAllocator:
+    def test_reserves_page_zero(self):
+        a = PageAllocator(8, 16)
+        got = set()
+        while True:
+            p = a.alloc(1)
+            if p is None:
+                break
+            got.update(p)
+        assert 0 not in got
+        assert got == set(range(1, 8))
+
+    def test_all_or_nothing(self):
+        a = PageAllocator(5, 16)
+        assert a.alloc(10) is None
+        assert a.available() == 4  # nothing leaked
+        pages = a.alloc(4)
+        assert len(pages) == 4
+        a.free(pages)
+        assert a.available() == 4
+
+    def test_pin_accounting(self):
+        a = PageAllocator(8, 16)
+        pages = a.alloc(3)
+        a.pin("conv1", pages)
+        assert a.pinned_pages() == 3
+        back = a.unpin("conv1")
+        assert back == pages
+        assert a.pinned_pages() == 0
+
+    def test_pages_for(self):
+        assert PageAllocator.pages_for(1, 16) == 1
+        assert PageAllocator.pages_for(16, 16) == 1
+        assert PageAllocator.pages_for(17, 16) == 2
+
+
+# -- echo engine --------------------------------------------------------------
+
+def make_echo_engine(slots=4, num_pages=64, page_size=8, max_pages=16,
+                     clock=None, **kw):
+    tok = ByteTokenizer()
+    ex = EchoExecutor(batch_size=slots, page_size=page_size,
+                      num_pages=num_pages, max_pages_per_seq=max_pages,
+                      eos_id=tok.eos_id)
+    return InferenceEngine(ex, tok, enable_metrics=False, clock=clock, **kw)
+
+
+class TestEchoEngine:
+    def test_single_request_echoes(self):
+        eng = make_echo_engine()
+        h = eng.submit(GenRequest(id="r1", prompt="hello"))
+        eng.run_until_idle()
+        assert h.done
+        assert h.result.text == "hello"
+        assert h.result.finish_reason == "eos"
+        assert h.result.prompt_tokens == 5
+        # All pages returned to the pool.
+        assert eng.allocator.used() == 0
+
+    def test_batched_requests(self):
+        eng = make_echo_engine(slots=4)
+        prompts = [f"message-{i}" for i in range(10)]
+        handles = [eng.submit(GenRequest(id=f"r{i}", prompt=p))
+                   for i, p in enumerate(prompts)]
+        eng.run_until_idle()
+        for h, p in zip(handles, prompts):
+            assert h.result.text == p
+        assert eng.allocator.used() == 0
+
+    def test_max_new_tokens_truncates(self):
+        eng = make_echo_engine()
+        h = eng.submit(GenRequest(id="r1", prompt="abcdefgh",
+                                  max_new_tokens=3))
+        eng.run_until_idle()
+        assert h.result.text == "abc"
+        assert h.result.finish_reason == "length"
+
+    def test_cancellation(self):
+        eng = make_echo_engine(slots=1)
+        h1 = eng.submit(GenRequest(id="r1", prompt="x" * 50))
+        h2 = eng.submit(GenRequest(id="r2", prompt="y" * 50))
+        h2.cancel()
+        eng.run_until_idle()
+        assert h1.result.finish_reason == "eos"
+        assert h2.result.finish_reason == "cancelled"
+
+    def test_priority_order_single_slot(self):
+        eng = make_echo_engine(slots=1)
+        finish_order = []
+        hs = {}
+        for name, prio in (("low", Priority.LOW), ("rt", Priority.REALTIME),
+                           ("norm", Priority.NORMAL)):
+            hs[name] = eng.submit(GenRequest(id=name, prompt="zz",
+                                             priority=prio))
+        # Nothing admitted yet; first step admits in priority order.
+        for _ in range(100):
+            eng.step()
+            for name, h in hs.items():
+                if h.done and name not in finish_order:
+                    finish_order.append(name)
+            if len(finish_order) == 3:
+                break
+        assert finish_order == ["rt", "norm", "low"]
+
+
+class TestPreemption:
+    def test_realtime_preempts_low(self):
+        eng = make_echo_engine(slots=1)
+        hlow = eng.submit(GenRequest(id="low", prompt="L" * 40,
+                                     priority=Priority.LOW))
+        eng.step()  # admit low, first decode
+        assert not hlow.done
+        hrt = eng.submit(GenRequest(id="rt", prompt="R" * 4,
+                                    priority=Priority.REALTIME))
+        eng.run_until_idle()
+        assert hrt.result.text == "R" * 4
+        assert hlow.result.text == "L" * 40  # resumed and completed intact
+        assert hrt.result.finish_reason == "eos"
+
+    def test_no_preemption_when_disabled(self):
+        eng = make_echo_engine(slots=1, preemption=False)
+        hlow = eng.submit(GenRequest(id="low", prompt="L" * 40,
+                                     priority=Priority.LOW))
+        eng.step()
+        eng.submit(GenRequest(id="rt", prompt="R" * 4,
+                              priority=Priority.REALTIME))
+        # Low finishes first because it cannot be displaced.
+        for _ in range(200):
+            eng.step()
+            if hlow.done:
+                break
+        assert hlow.done
+
+    def test_equal_priority_never_preempts(self):
+        eng = make_echo_engine(slots=1)
+        h1 = eng.submit(GenRequest(id="a", prompt="A" * 30,
+                                   priority=Priority.HIGH))
+        eng.step()
+        h2 = eng.submit(GenRequest(id="b", prompt="B" * 5,
+                                   priority=Priority.HIGH))
+        for _ in range(200):
+            eng.step()
+            if h1.done and h2.done:
+                break
+        # FIFO within tier: a (earlier) completed before b started late.
+        assert h1.done and h2.done
+
+
+class TestConversationKV:
+    def test_second_turn_reuses_cache(self):
+        eng = make_echo_engine()
+        h1 = eng.submit(GenRequest(id="t1", prompt="first turn",
+                                   conversation_id="c1"))
+        eng.run_until_idle()
+        assert h1.result.cached_tokens == 0
+        used_after_t1 = eng.allocator.used()
+        assert used_after_t1 > 0  # pages stay pinned for the conversation
+        assert eng.cached_conversations() == ["c1"]
+
+        h2 = eng.submit(GenRequest(id="t2", prompt="second",
+                                   conversation_id="c1"))
+        eng.run_until_idle()
+        assert h2.result.cached_tokens == len("first turn") + len("first turn")
+        # turn-1 prompt + its echoed output are in the cache
+        assert h2.result.text == "second"
+
+    def test_conversation_eviction_frees_pages(self):
+        eng = make_echo_engine()
+        eng.submit(GenRequest(id="t1", prompt="hello", conversation_id="c1"))
+        eng.run_until_idle()
+        assert eng.allocator.used() > 0
+        eng.drop_conversation("c1")
+        assert eng.allocator.used() == 0
+        assert eng.cached_conversations() == []
+
+    def test_pin_ttl_expiry(self):
+        clock = FakeClock()
+        eng = make_echo_engine(clock=clock, kv_pin_ttl=10.0)
+        eng.submit(GenRequest(id="t1", prompt="hello", conversation_id="c1"))
+        eng.run_until_idle()
+        assert eng.cached_conversations() == ["c1"]
+        clock.advance(11.0)
+        eng.step()
+        assert eng.cached_conversations() == []
+        assert eng.allocator.used() == 0
+
+    def test_touch_refreshes_ttl(self):
+        clock = FakeClock()
+        eng = make_echo_engine(clock=clock, kv_pin_ttl=10.0)
+        eng.submit(GenRequest(id="t1", prompt="hello", conversation_id="c1"))
+        eng.run_until_idle()
+        clock.advance(8.0)
+        eng.touch_conversation("c1")
+        clock.advance(8.0)
+        eng.step()
+        assert eng.cached_conversations() == ["c1"]  # touch reset the clock
+
+    def test_pool_pressure_evicts_lru_conversation(self):
+        # 23 usable pages of 8 tokens; each conversation pins 8 pages
+        # (30 prompt + 30 echo + 1), so the 16-page "big" request must
+        # reclaim the LRU conversation (ca) to finish.
+        eng = make_echo_engine(num_pages=24, page_size=8, max_pages=16)
+        eng.submit(GenRequest(id="a", prompt="a" * 30, conversation_id="ca"))
+        eng.run_until_idle()
+        eng.submit(GenRequest(id="b", prompt="b" * 30, conversation_id="cb"))
+        eng.run_until_idle()
+        assert set(eng.cached_conversations()) == {"ca", "cb"}
+        # A big non-conversation request forces LRU eviction of ca.
+        h = eng.submit(GenRequest(id="big", prompt="x" * 60))
+        eng.run_until_idle()
+        assert h.result.text == "x" * 60
+        assert "ca" not in eng.cached_conversations()
+
+    def test_concurrent_same_conversation_serialised(self):
+        eng = make_echo_engine(slots=4)
+        h1 = eng.submit(GenRequest(id="t1", prompt="one", conversation_id="c"))
+        h2 = eng.submit(GenRequest(id="t2", prompt="two", conversation_id="c"))
+        eng.run_until_idle()
+        assert h1.result.finish_reason == "eos"
+        assert h2.result.finish_reason == "eos"
+        # Turn 2 saw turn 1's cache (its tokens + echo).
+        assert h2.result.cached_tokens == 2 * len("one")
+
+
+class TestEngineThread:
+    def test_background_loop_and_generate(self):
+        eng = make_echo_engine()
+        eng.start()
+        try:
+            res = eng.generate("threaded", timeout=10.0)
+            assert res.text == "threaded"
+        finally:
+            eng.stop()
+        assert not eng.running
+
+    def test_process_fn_seam(self):
+        """Worker drains the queue into the engine — the reference's
+        ProcessFunc seam (worker.go:33) filled by real execution."""
+        from llmq_tpu.queueing.queue_manager import QueueManager
+        from llmq_tpu.queueing.worker import Worker
+
+        eng = make_echo_engine()
+        eng.start()
+        qm = QueueManager("engine-test", enable_metrics=False)
+        w = Worker("w0", qm, eng.process_fn)
+        try:
+            msgs = [Message(content=f"payload-{i}",
+                            priority=Priority(1 + i % 4)) for i in range(8)]
+            for m in msgs:
+                qm.push_message(m)
+            w.start()
+            deadline = threading.Event()
+            for _ in range(100):
+                if all(m.status == MessageStatus.COMPLETED for m in msgs):
+                    break
+                deadline.wait(0.05)
+            assert all(m.status == MessageStatus.COMPLETED for m in msgs)
+            for m in msgs:
+                assert m.response == m.content
+                assert m.metadata["usage"]["completion_tokens"] > 0
+        finally:
+            w.stop()
+            eng.stop()
+
+
+# -- JAX executor -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from llmq_tpu.models.llama import init_params, llama3_tiny
+
+    cfg = llama3_tiny(dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                      ffn_dim=128, vocab_size=512, max_seq_len=256)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_jax_engine(tiny_model, slots=2, num_pages=64, page_size=8, **kw):
+    cfg, params = tiny_model
+    tok = ByteTokenizer()
+    ex = JaxExecutor(cfg, params, batch_size=slots, page_size=page_size,
+                     num_pages=num_pages, prefill_buckets=[16, 64],
+                     eos_id=tok.eos_id)
+    return InferenceEngine(ex, tok, enable_metrics=False,
+                           max_decode_steps=8, **kw)
+
+
+def reference_greedy(cfg, params, prompt_ids, n_steps):
+    """Dense single-sequence greedy decode, independent of the engine."""
+    import jax.numpy as jnp
+
+    from llmq_tpu.models.llama import forward_decode, forward_prefill, init_kv_pages
+
+    page_size = 8
+    pages = init_kv_pages(cfg, 64, page_size)
+    max_pages = 32
+    bt = jnp.arange(1, max_pages + 1, dtype=jnp.int32)[None, :]
+    n = len(prompt_ids)
+    toks = jnp.asarray(prompt_ids, jnp.int32)[None, :]
+    pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+    logits, pages = forward_prefill(params, cfg, toks, pos,
+                                    jnp.asarray([n], jnp.int32), pages, bt)
+    out = [int(jnp.argmax(logits[0, n - 1]))]
+    cur = out[0]
+    for i in range(n_steps - 1):
+        lg, pages = forward_decode(
+            params, cfg, jnp.asarray([cur], jnp.int32),
+            jnp.asarray([n + i], jnp.int32), pages, bt)
+        cur = int(jnp.argmax(lg[0]))
+        out.append(cur)
+    return out
+
+
+class TestJaxEngine:
+    def test_greedy_matches_reference(self, tiny_model):
+        cfg, params = tiny_model
+        eng = make_jax_engine(tiny_model)
+        prompt = "hello world"
+        h = eng.submit(GenRequest(id="r", prompt=prompt, max_new_tokens=6))
+        eng.run_until_idle()
+        got = h.result.tokens
+        tok = ByteTokenizer()
+        want = reference_greedy(cfg, params, tok.encode(prompt), 6)
+        # EOS may cut the engine's output short; compare the prefix.
+        assert got == want[: len(got)]
+        assert len(got) >= 1
+
+    def test_batched_equals_single(self, tiny_model):
+        """Continuous batching must not change any sequence's tokens."""
+        eng2 = make_jax_engine(tiny_model, slots=2)
+        prompts = ["alpha beta", "gamma delta epsilon"]
+        hs = [eng2.submit(GenRequest(id=f"r{i}", prompt=p, max_new_tokens=5))
+              for i, p in enumerate(prompts)]
+        eng2.run_until_idle()
+
+        for p, h in zip(prompts, hs):
+            eng1 = make_jax_engine(tiny_model, slots=1)
+            h1 = eng1.submit(GenRequest(id="solo", prompt=p, max_new_tokens=5))
+            eng1.run_until_idle()
+            assert h.result.tokens == h1.result.tokens
+
+    def test_conversation_continuation_matches_full_prefill(self, tiny_model):
+        """Turn 2 on cached KV must produce the same tokens as prefilling
+        the whole history from scratch (numeric KV-reuse correctness)."""
+        t1, t2 = "abc", "defg"
+        # Engine A: two turns through the conversation cache.
+        engA = make_jax_engine(tiny_model)
+        h1 = engA.submit(GenRequest(id="t1", prompt=t1, conversation_id="c",
+                                    max_new_tokens=4))
+        engA.run_until_idle()
+        h2 = engA.submit(GenRequest(id="t2", prompt=t2, conversation_id="c",
+                                    max_new_tokens=4))
+        engA.run_until_idle()
+        assert h2.result.cached_tokens > 0
+
+        # Engine B: one shot over the concatenated history.
+        tok = ByteTokenizer()
+        history = tok.encode(t1) + h1.result.tokens + tok.encode(t2)
+        cfg, params = tiny_model
+        want = reference_greedy(cfg, params, history, 4)
+        got = h2.result.tokens
+        assert got == want[: len(got)]
+
+    def test_long_prompt_chunked_prefill(self, tiny_model):
+        """Prompts beyond the largest bucket stream through it in chunks."""
+        cfg, params = tiny_model
+        eng = make_jax_engine(tiny_model)  # buckets [16, 64]
+        prompt = "x" * 100                 # > 64 → two chunks
+        h = eng.submit(GenRequest(id="r", prompt=prompt, max_new_tokens=3))
+        eng.run_until_idle()
+        tok = ByteTokenizer()
+        want = reference_greedy(cfg, params, tok.encode(prompt), 3)
+        assert h.result.tokens == want[: len(h.result.tokens)]
