@@ -201,6 +201,10 @@ class ExecutorConfig:
     kv_pages: int = 512
     page_size: int = 16                 # tokens per KV page
     max_decode_steps: int = 256
+    # Decode steps per device program call: sampling + EOS latching stay
+    # on-device for this many tokens, amortizing host↔device latency.
+    # Also the engine's admission/preemption granularity.
+    decode_chunk: int = 16
     preemption: bool = True
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
 

@@ -38,7 +38,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             num_pages=ex.kv_pages,
             max_pages_per_seq=max(
                 1, cfg.model.max_seq_len // ex.page_size),
-            eos_id=tokenizer.eos_id)
+            eos_id=tokenizer.eos_id,
+            chunk_size=ex.decode_chunk)
     elif ex.backend == "jax":
         import jax
 
@@ -62,10 +63,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
                 params = import_hf_llama(
                     path, mcfg, meta_rope_layout=cfg.model.meta_rope_layout)
             elif path:
-                try:
-                    params = load_checkpoint(path)
-                except Exception:
-                    log.exception("checkpoint load failed; random init")
+                # An explicitly configured checkpoint that fails to load
+                # must abort startup — silently serving random weights is
+                # worse than not serving.
+                params = load_checkpoint(path)
             if params is None:
                 params = init_params(jax.random.PRNGKey(0), mcfg)
         executor = JaxExecutor(
@@ -74,7 +75,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             page_size=ex.page_size,
             num_pages=ex.kv_pages,
             prefill_buckets=list(ex.prefill_buckets),
-            eos_id=tokenizer.eos_id)
+            eos_id=tokenizer.eos_id,
+            chunk_size=ex.decode_chunk)
         if warmup:
             executor.warmup()
     else:

@@ -128,7 +128,7 @@ class _Sequence:
     __slots__ = ("req", "handle", "prompt_ids", "generated", "pages",
                  "block_table", "pos", "cached_len", "last_token", "slot",
                  "prefilled", "order", "adopted", "prefill_ids",
-                 "prefill_start", "carry")
+                 "prefill_start", "carry", "written_ids", "rebuild")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -148,6 +148,12 @@ class _Sequence:
         self.prefill_ids: List[int] = []  # what prefill saw (for resume)
         self.prefill_start = 0
         self.carry: List[int] = []        # cache's pending token (see _ConvKV)
+        #: Token ids whose KV occupies positions [0, pos) — the exact
+        #: content of this sequence's pages. Lets a page-releasing
+        #: preemption (or a capacity fold) rebuild the FULL context,
+        #: including adopted conversation history, by re-prefilling.
+        self.written_ids: List[int] = []
+        self.rebuild = False      # pages were released; re-prefill written_ids
 
     def sort_key(self):
         return (int(self.req.priority), self.order)
@@ -161,6 +167,11 @@ class _ConvKV:
     block_table: np.ndarray
     length: int                  # tokens cached
     last_used: float
+    #: The token ids backing the cached KV, positions [0, length) — kept
+    #: so the cache can be rebuilt from text if its pages are reclaimed
+    #: mid-turn, and so an over-capacity turn can fold the prefix into a
+    #: sliding-window re-prefill.
+    tokens: List[int] = field(default_factory=list)
     #: On a "length" finish the final sampled token never went through a
     #: decode step, so its KV is absent — the next turn must prefill it
     #: first or the cached history silently misses one token.
@@ -412,18 +423,14 @@ class InferenceEngine:
         """Step-boundary preemption: the victim's slot is handed over; its
         KV pages stay resident (cheap resume) unless the pool itself is
         the contended resource, in which case it later resumes by
-        re-prefilling prompt + generated-so-far."""
+        re-prefilling its full written context (``written_ids`` — which
+        includes any adopted conversation history)."""
         assert victim.slot is not None
         self._slots[victim.slot] = None
         self.executor.release_slot(victim.slot)
         victim.slot = None
         if release_pages:
-            self.allocator.free(victim.pages)
-            victim.pages = []
-            victim.block_table[:] = 0
-            victim.pos = 0
-            victim.cached_len = 0
-            victim.prefilled = False
+            self._release_sequence_pages(victim)
         heapq.heappush(self._pending,
                        (int(victim.req.priority), victim.order, victim))
         if self._metrics:
@@ -432,6 +439,19 @@ class InferenceEngine:
         log.info("preempted %s (%s)%s", victim.req.id,
                  victim.req.priority.tier_name,
                  " releasing pages" if release_pages else "")
+
+    def _release_sequence_pages(self, seq: _Sequence) -> None:
+        """Take ``seq``'s KV pages back into the pool. The sequence will
+        rebuild by re-prefilling ``written_ids`` when next admitted."""
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+        seq.block_table[:] = 0
+        seq.pos = 0
+        seq.cached_len = 0
+        if seq.prefilled:
+            seq.rebuild = True
+        seq.prefilled = False
 
     def _reclaim_idle_conversation(self) -> bool:
         """LRU-evict one idle pinned conversation to relieve pool
@@ -445,19 +465,44 @@ class InferenceEngine:
         log.info("evicted conversation KV %s under pool pressure", cid)
         return True
 
+    def _reclaim_pending_pages(self, requester: _Sequence) -> bool:
+        """Release pages held by a *pending* sequence (slot-preempted
+        earlier, pages kept for cheap resume) that is strictly less
+        urgent than ``requester``. Without this, pages parked in the
+        pending heap are invisible to shedding and admission can
+        deadlock with the pool exhausted and every slot empty."""
+        worst: Optional[_Sequence] = None
+        for _, _, seq in self._pending:
+            if seq is requester or not seq.pages:
+                continue
+            if worst is None or seq.sort_key() > worst.sort_key():
+                worst = seq
+        if worst is None or worst.sort_key() <= requester.sort_key():
+            return False
+        self._release_sequence_pages(worst)
+        log.info("reclaimed pages of pending %s for %s",
+                 worst.req.id, requester.req.id)
+        return True
+
     def _alloc_pages(self, n: int,
-                     protect: Optional[_Sequence] = None) -> Optional[List[int]]:
-        """Allocate with shedding: idle conversation KV first, then
-        preempt-with-release of the least urgent runner (never
-        ``protect``)."""
+                     requester: _Sequence) -> Optional[List[int]]:
+        """Allocate with shedding, in increasing order of damage: idle
+        pinned conversation KV (LRU) first, then pages parked with
+        less-urgent *pending* sequences, then preempt-with-release of a
+        strictly less-urgent runner. A victim is only ever less urgent
+        than ``requester`` — a low-tier request can never strip a
+        realtime sequence's KV (priority inversion)."""
         while True:
             pages = self.allocator.alloc(n)
             if pages is not None:
                 return pages
             if self._reclaim_idle_conversation():
                 continue
-            victim = self._least_urgent_active(exclude=protect)
-            if victim is not None and self.preemption_enabled:
+            if self._reclaim_pending_pages(requester):
+                continue
+            victim = self._least_urgent_active(exclude=requester)
+            if (victim is not None and self.preemption_enabled
+                    and victim.sort_key() > requester.sort_key()):
                 self._preempt(victim, release_pages=True)
                 continue
             return None
@@ -483,6 +528,7 @@ class InferenceEngine:
                     seq.pos = kv.length
                     seq.block_table[:] = kv.block_table
                     seq.pages = list(kv.pages)
+                    seq.written_ids = list(kv.tokens)
                     if kv.pending is not None:
                         seq.carry = [kv.pending]
             if not seq.prompt_ids:
@@ -492,18 +538,44 @@ class InferenceEngine:
                 ids = self.tokenizer.encode(text)
                 seq.prompt_ids = ids or [self.tokenizer.bos_id]
 
-            start_pos = seq.cached_len
-            # KV to (re)build: prompt plus all previously sampled tokens
-            # except the newest (whose KV is written by its decode step).
             resume_last: Optional[int] = None
-            ids = seq.carry + seq.prompt_ids
-            if seq.generated:
-                ids = ids + seq.generated[:-1]
-                resume_last = seq.generated[-1]
+            if seq.rebuild:
+                # Pages were reclaimed mid-flight: re-prefill the exact
+                # written context (adopted history + prompt + generated
+                # so far), then resume decoding from the newest token.
+                ids = list(seq.written_ids)
+                start_pos = 0
+                if seq.generated:
+                    resume_last = seq.generated[-1]
+                elif seq.carry:
+                    # Never produced a token: the carry tail re-enters
+                    # through ids; nothing to resume.
+                    pass
+            else:
+                start_pos = seq.cached_len
+                # KV to (re)build: prompt plus all previously sampled
+                # tokens except the newest (whose KV is written by its
+                # decode step).
+                ids = seq.carry + seq.prompt_ids
+                if seq.generated:
+                    ids = ids + seq.generated[:-1]
+                    resume_last = seq.generated[-1]
 
             capacity = self.spec.max_pages_per_seq * self.spec.page_size
-            if start_pos + len(ids) + 1 > capacity:
-                keep = capacity - start_pos - max(
+            if start_pos + len(ids) + 1 > capacity and start_pos > 0:
+                # The cached prefix + new tokens exceed the block table.
+                # Fold the prefix into a from-scratch rebuild so the
+                # window can slide (written_ids holds its token ids).
+                ids = seq.written_ids + ids
+                if seq.pages:
+                    self.allocator.free(seq.pages)
+                    seq.pages = []
+                seq.block_table[:] = 0
+                start_pos = 0
+                seq.pos = 0
+                seq.cached_len = 0
+            if len(ids) + 1 > capacity:
+                keep = capacity - max(
                     1, min(self.max_decode_steps, capacity // 4))
                 if keep < 1:
                     self._finish(seq, "error",
@@ -519,17 +591,30 @@ class InferenceEngine:
                              f"{self.allocator.total}")
                 return True
             if need > 0:
-                pages = self._alloc_pages(need, protect=None)
+                pages = self._alloc_pages(need, seq)
                 if pages is None:
                     return False
                 seq.block_table[have:have + need] = pages
                 seq.pages.extend(pages)
 
+            was_rebuild = seq.rebuild
             first = self.executor.prefill(ids, start_pos, seq.block_table,
                                           req.temperature, slot)
             seq.pos = start_pos + len(ids)
-            seq.prefill_ids = ids
-            seq.prefill_start = start_pos
+            if was_rebuild or start_pos == 0:
+                seq.written_ids = list(ids)
+            else:
+                seq.written_ids.extend(ids)
+            seq.rebuild = False
+            if was_rebuild and seq.generated:
+                # KV is rebuilt, but per-slot-state executors (the echo
+                # mock) must see the ORIGINAL prefill stream, not the
+                # history+output mix we just replayed.
+                self.executor.resume(slot, seq.prefill_ids,
+                                     seq.prefill_start)
+            else:
+                seq.prefill_ids = ids
+                seq.prefill_start = start_pos
             seq.prefilled = True
             seq.slot = slot
             self._slots[slot] = seq
@@ -555,34 +640,54 @@ class InferenceEngine:
         self._slots[slot] = seq
         return True
 
-    def _ensure_decode_page(self, seq: _Sequence) -> bool:
-        """The next decode step writes KV at ``seq.pos`` — make sure a
-        page backs it."""
-        idx = seq.pos // self.spec.page_size
-        if idx < len(seq.pages):
+    def _budget_for(self, seq: _Sequence, chunk: int) -> int:
+        """Token budget for ``seq`` this chunk: bounded by the remaining
+        max_new_tokens allowance and the block-table capacity."""
+        limit = seq.req.max_new_tokens or self.max_decode_steps
+        remaining = max(1, limit - len(seq.generated))
+        capacity = self.spec.max_pages_per_seq * self.spec.page_size
+        headroom = capacity - seq.pos
+        return max(1, min(chunk, remaining, headroom))
+
+    def _ensure_decode_pages(self, seq: _Sequence, budget: int) -> bool:
+        """The next ``budget`` decode steps write KV at positions
+        ``[seq.pos, seq.pos+budget)`` — make sure pages back them."""
+        need = PageAllocator.pages_for(
+            seq.pos + budget, self.spec.page_size) - len(seq.pages)
+        if need <= 0:
             return True
-        pages = self._alloc_pages(1, protect=seq)
+        pages = self._alloc_pages(need, seq)
         if pages is None:
             return False
-        seq.block_table[len(seq.pages)] = pages[0]
+        seq.block_table[len(seq.pages):len(seq.pages) + need] = pages
         seq.pages.extend(pages)
         return True
 
     def _decode_once(self) -> bool:
         B = self.spec.batch_size
+        chunk = max(1, getattr(self.executor, "chunk_size", 1))
         active = [s for s in self._slots if s is not None]
         if not active:
             self._set_gauges()
             return False
+        budgets_by_order: Dict[int, int] = {}
         for seq in list(active):
+            if seq.slot is None:
+                continue  # shed by an earlier sequence's page allocation
             if seq.handle.cancelled:
                 self._finish_active(seq, "cancelled")
-            elif seq.pos // self.spec.page_size >= self.spec.max_pages_per_seq:
+                continue
+            if seq.pos // self.spec.page_size >= self.spec.max_pages_per_seq:
                 self._finish_active(seq, "length")  # block table exhausted
-            elif not self._ensure_decode_page(seq):
+                continue
+            budget = self._budget_for(seq, chunk)
+            if not self._ensure_decode_pages(seq, budget):
                 # Pool exhausted even after shedding everyone else:
                 # requeue this one rather than truncating its output.
-                self._preempt(seq, release_pages=True)
+                if seq.slot is not None:  # may have been shed already
+                    self._preempt(seq, release_pages=True)
+                continue
+            budgets_by_order[seq.order] = budget
         active = [s for s in self._slots if s is not None]
         if not active:
             self._set_gauges()
@@ -592,20 +697,34 @@ class InferenceEngine:
         positions = np.zeros(B, np.int32)
         block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
         temps = np.zeros(B, np.float32)
+        budgets = np.zeros(B, np.int32)
         for seq in active:
             i = seq.slot
             tokens[i] = seq.last_token
             positions[i] = seq.pos
             block_tables[i] = seq.block_table
             temps[i] = seq.req.temperature
-        out = self.executor.decode(tokens, positions, block_tables, temps)
+            budgets[i] = budgets_by_order.get(seq.order, 1)
+        if chunk > 1 and hasattr(self.executor, "decode_chunk"):
+            out = self.executor.decode_chunk(tokens, positions, block_tables,
+                                             temps, budgets)
+        else:
+            out = self.executor.decode(tokens, positions, block_tables,
+                                       temps)[:, None]
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
         for seq in active:
-            nxt = int(out[seq.slot])
-            seq.pos += 1          # last_token's KV is now written
-            self._commit_token(seq, nxt)
+            row = out[seq.slot]
+            for j in range(int(budgets[seq.slot])):
+                nxt = int(row[j])
+                # The token fed at step j (the previous last_token) has
+                # its KV written at seq.pos now.
+                seq.written_ids.append(seq.last_token)
+                seq.pos += 1
+                self._commit_token(seq, nxt)
+                if seq.slot is None:   # finished (eos/length/cancel)
+                    break
         self._set_gauges()
         return True
 
@@ -634,11 +753,16 @@ class InferenceEngine:
                     self._conv_drop_pending.discard(conv)
                     self.allocator.free(seq.pages)
                 else:
+                    if len(seq.written_ids) != seq.pos:
+                        log.warning(
+                            "written_ids/pos mismatch for %s: %d vs %d",
+                            seq.req.id, len(seq.written_ids), seq.pos)
                     self._conv_cache[conv] = _ConvKV(
                         pages=list(seq.pages),
                         block_table=seq.block_table.copy(),
                         length=seq.pos,
                         last_used=self._clock.now(),
+                        tokens=list(seq.written_ids),
                         pending=(seq.last_token if reason == "length"
                                  else None))
                     self.allocator.pin(conv, seq.pages)

@@ -11,10 +11,19 @@ decode step. Two backends:
   cmd/queue-manager/main.go:139-153, with actual instant compute).
 - :class:`JaxExecutor` — the TPU path (BASELINE configs #2/#3/#5): paged
   KV pool in device memory, bucketed prefill (one compile per bucket),
-  one fixed-geometry jitted decode step for the whole batch with the KV
-  pool **donated** so XLA updates it in place instead of copying the pool
-  every step, and in-jit sampling so only (B,) token ids cross back to
-  the host per step.
+  one fixed-geometry jitted decode program for the whole batch with the
+  KV pool **donated** so XLA updates it in place instead of copying the
+  pool every step, and in-jit sampling so only token ids cross back to
+  the host.
+
+Decode runs **multiple steps per host round-trip** (``decode_chunk``): a
+``lax.scan`` over K inner steps keeps sampling on device, latches EOS
+(finished rows stop advancing and scatter their KV to reserved page 0),
+and honors a per-sequence token ``budget`` — so one host↔device transfer
+returns up to K tokens per sequence. Host↔device latency (PCIe, or ~75ms
+RTT on tunneled setups) is amortized K× instead of being paid per token;
+the engine's scheduling granularity (admission/preemption) becomes K
+tokens, which bounds realtime admission latency to K decode steps.
 """
 
 from __future__ import annotations
@@ -43,6 +52,8 @@ class ExecutorSpec:
 
 class Executor(Protocol):
     spec: ExecutorSpec
+    #: Tokens produced per decode_chunk call (1 → engine single-steps).
+    chunk_size: int
 
     def prefill(self, tokens: List[int], start_pos: int,
                 block_table: np.ndarray, temperature: float,
@@ -58,6 +69,19 @@ class Executor(Protocol):
         """One batched decode step. All arrays are full batch-size; the
         engine ignores outputs of inactive slots (their rows point at
         page 0). Returns (B,) next tokens."""
+        ...
+
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                     block_tables: np.ndarray, temperatures: np.ndarray,
+                     budgets: np.ndarray) -> np.ndarray:
+        """Up to ``chunk_size`` decode steps in one device program.
+
+        Per-row semantics, identical to ``chunk_size`` single ``decode``
+        calls: step j writes the KV of the current token at the current
+        position, samples the next. A row stops (latches) when it samples
+        EOS or exhausts its ``budgets[b]`` steps; latched rows emit EOS
+        and write KV to reserved page 0. Rows with budget 0 never run.
+        Returns (B, chunk_size) next tokens."""
         ...
 
     def release_slot(self, slot: int) -> None:
@@ -82,9 +106,10 @@ class EchoExecutor:
 
     def __init__(self, batch_size: int = 8, page_size: int = 16,
                  num_pages: int = 512, max_pages_per_seq: int = 32,
-                 eos_id: int = 2) -> None:
+                 eos_id: int = 2, chunk_size: int = 1) -> None:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
+        self.chunk_size = chunk_size
         self._slot_prompt: Dict[int, List[int]] = {}
         self._slot_end: Dict[int, int] = {}   # absolute pos after prompt
         self._mu = threading.Lock()
@@ -109,6 +134,25 @@ class EchoExecutor:
                 nxt = k + 1
                 if 0 <= nxt < len(prompt):
                     out[slot] = prompt[nxt]
+        return out
+
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                     block_tables: np.ndarray, temperatures: np.ndarray,
+                     budgets: np.ndarray) -> np.ndarray:
+        K = self.chunk_size
+        B = self.spec.batch_size
+        out = np.full((B, K), self.spec.eos_id, np.int32)
+        tok = np.asarray(tokens, np.int32).copy()
+        pos = np.asarray(positions, np.int32).copy()
+        done = np.asarray(budgets, np.int32) <= 0
+        for j in range(K):
+            active = ~done
+            nxt = self.decode(tok, pos, block_tables, temperatures)
+            nxt = np.where(active, nxt, self.spec.eos_id).astype(np.int32)
+            out[:, j] = nxt
+            pos = pos + active.astype(np.int32)
+            done = done | (nxt == self.spec.eos_id) | (j + 1 >= budgets)
+            tok = nxt
         return out
 
     def release_slot(self, slot: int) -> None:
@@ -140,7 +184,8 @@ class JaxExecutor:
                  page_size: int = 16, num_pages: int = 512,
                  prefill_buckets: Optional[List[int]] = None,
                  top_k: int = 0, top_p: float = 1.0, eos_id: int = 2,
-                 cache_dtype=None, seed: int = 0) -> None:
+                 cache_dtype=None, seed: int = 0,
+                 chunk_size: int = 16) -> None:
         import jax
         import jax.numpy as jnp
         from functools import partial
@@ -157,20 +202,24 @@ class JaxExecutor:
             1, model_cfg.max_seq_len // page_size)
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
+        self.chunk_size = max(1, chunk_size)
         self.prefill_buckets = sorted(prefill_buckets or [32, 128, 512])
         self.cache = init_kv_pages(model_cfg, num_pages, page_size,
                                    dtype=cache_dtype)
         self._key = jax.random.PRNGKey(seed)
 
         cfg = model_cfg
+        eos = eos_id
 
         @partial(jax.jit, donate_argnums=(1,))
         def _prefill_step(params, cache, tokens, positions, lengths,
-                          block_tables):
+                          block_tables, temperature, key):
             logits, cache = forward_prefill(
                 params, cfg, tokens, positions, lengths, cache, block_tables)
-            last = logits[0, lengths[0] - 1]  # (V,) f32
-            return last, cache
+            last = logits[0, lengths[0] - 1][None, :]  # (1, V) f32
+            tok = sample_token(last, key, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            return tok[0], cache
 
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_step(params, cache, tokens, positions, block_tables,
@@ -181,8 +230,38 @@ class JaxExecutor:
                                 top_k=top_k, top_p=top_p)
             return toks, cache
 
+        K = self.chunk_size
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_chunk(params, cache, tokens, positions, block_tables,
+                          temperatures, budgets, key):
+            """K decode steps on device: sampling, EOS latching and
+            per-row budgets stay in the program; one host transfer of
+            (B, K) token ids per call."""
+            def body(carry, step):
+                cache, tok, pos, done = carry
+                j, key_j = step
+                active = ~done
+                logits, cache = forward_decode(
+                    params, cfg, tok, pos, cache, block_tables,
+                    active=active)
+                nxt = sample_token(logits, key_j, temperature=temperatures,
+                                   top_k=top_k, top_p=top_p)
+                nxt = jnp.where(active, nxt, eos).astype(jnp.int32)
+                pos = pos + active.astype(jnp.int32)
+                done = done | (nxt == eos) | (j + 1 >= budgets)
+                return (cache, nxt, pos, done), nxt
+
+            keys = jax.random.split(key, K)
+            done0 = budgets <= 0
+            (cache, _, _, _), outs = jax.lax.scan(
+                body, (cache, tokens, positions, done0),
+                (jnp.arange(K), keys))
+            return outs.T, cache  # (B, K)
+
         self._prefill_step = _prefill_step
         self._decode_step = _decode_step
+        self._decode_chunk = _decode_chunk
 
     # -- helpers -------------------------------------------------------------
 
@@ -201,15 +280,21 @@ class JaxExecutor:
         (the reference has no analogue; SURVEY §7 'warmup at startup')."""
         spec = self.spec
         bt = np.zeros((1, spec.max_pages_per_seq), np.int32)
+        prev = 0
         for b in self.prefill_buckets:
-            self.prefill([1] * min(b, 2), 0, bt[0], 0.0, 0)
+            # One full-size prefill per bucket: lengths prev+1..b stream a
+            # chunk of exactly size-b through the bucket-b program.
+            self.prefill([1] * min(b, prev + 1), 0, bt[0], 0.0, 0)
+            prev = b
         # Reset pool: warmup wrote garbage KV into page 0 only (block
         # table all-zero), which is never read — nothing to clean.
-        self.decode(np.zeros(spec.batch_size, np.int32),
-                    np.zeros(spec.batch_size, np.int32),
-                    np.zeros((spec.batch_size, spec.max_pages_per_seq),
-                             np.int32),
-                    np.zeros(spec.batch_size, np.float32))
+        zeros_b = np.zeros(spec.batch_size, np.int32)
+        zbt = np.zeros((spec.batch_size, spec.max_pages_per_seq), np.int32)
+        ztemp = np.zeros(spec.batch_size, np.float32)
+        self.decode(zeros_b, zeros_b, zbt, ztemp)
+        if self.chunk_size > 1:
+            self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
+                              np.ones(spec.batch_size, np.int32))
 
     # -- Executor API --------------------------------------------------------
 
@@ -221,7 +306,7 @@ class JaxExecutor:
         bt = jnp.asarray(block_table, jnp.int32)[None, :]
         pos = start_pos
         remaining = list(tokens)
-        last_logits = None
+        tok = None
         while remaining:
             chunk = remaining[: self.prefill_buckets[-1]]
             remaining = remaining[len(chunk):]
@@ -229,17 +314,18 @@ class JaxExecutor:
             padded = np.zeros(T, np.int32)
             padded[: len(chunk)] = chunk
             positions = np.minimum(pos + np.arange(T), pos + len(chunk) - 1)
-            last_logits, self.cache = self._prefill_step(
+            tok, self.cache = self._prefill_step(
                 self.params, self.cache,
                 jnp.asarray(padded)[None, :],
                 jnp.asarray(positions, jnp.int32)[None, :],
                 jnp.asarray([len(chunk)], jnp.int32),
-                bt)
+                bt,
+                jnp.asarray([temperature], jnp.float32),
+                self._next_key())
             pos += len(chunk)
-        if last_logits is None:
+        if tok is None:
             return spec.eos_id
-        logits = np.asarray(last_logits)
-        return int(_sample_host(logits, temperature, self._host_rng()))
+        return int(tok)
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray,
@@ -254,27 +340,22 @@ class JaxExecutor:
             self._next_key())
         return np.asarray(toks)
 
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                     block_tables: np.ndarray, temperatures: np.ndarray,
+                     budgets: np.ndarray) -> np.ndarray:
+        jnp = self._jnp
+        toks, self.cache = self._decode_chunk(
+            self.params, self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(temperatures, jnp.float32),
+            jnp.asarray(budgets, jnp.int32),
+            self._next_key())
+        return np.asarray(toks)
+
     def release_slot(self, slot: int) -> None:
         pass  # no per-slot host state
 
     def resume(self, slot: int, tokens: List[int], start_pos: int) -> None:
         pass  # block tables carry everything
-
-    _rng: Optional[np.random.Generator] = None
-
-    def _host_rng(self) -> np.random.Generator:
-        if self._rng is None:
-            self._rng = np.random.default_rng(1234)
-        return self._rng
-
-
-def _sample_host(logits: np.ndarray, temperature: float,
-                 rng: np.random.Generator) -> int:
-    """Host-side sampling for the single prefill logit vector (greedy when
-    temperature<=0). Decode-path sampling happens in-jit."""
-    if temperature <= 0.0:
-        return int(np.argmax(logits))
-    z = (logits - logits.max()) / temperature
-    p = np.exp(z)
-    p /= p.sum()
-    return int(rng.choice(len(p), p=p))

@@ -53,9 +53,14 @@ class HFTokenizer:
         from transformers import AutoTokenizer  # gated import
 
         self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
-        self.pad_id = self._tok.pad_token_id or 0
-        self.bos_id = self._tok.bos_token_id or 1
-        self.eos_id = self._tok.eos_token_id or 2
+
+        def _id(value, default):
+            # `or` would turn a legitimate token id 0 into the default.
+            return default if value is None else value
+
+        self.pad_id = _id(self._tok.pad_token_id, 0)
+        self.bos_id = _id(self._tok.bos_token_id, 1)
+        self.eos_id = _id(self._tok.eos_token_id, 2)
         self.vocab_size = len(self._tok)
 
     def encode(self, text: str) -> List[int]:

@@ -62,6 +62,17 @@ def llama3_tiny(**kw) -> LlamaConfig:
     return replace(LlamaConfig(), **kw)
 
 
+def llama3_1b(**kw) -> LlamaConfig:
+    # Public Llama-3.2-1B architecture constants. The largest family
+    # member whose bf16 weights + KV pool fit one 16 GB v5e chip —
+    # the single-chip benchmark model (BASELINE config #2 scaled to the
+    # available chip; 8B bf16 weights alone are 16 GB).
+    return replace(LlamaConfig(
+        name="llama3-1b", vocab_size=128256, dim=2048, n_layers=16,
+        n_heads=32, n_kv_heads=8, ffn_dim=8192, max_seq_len=8192,
+        rope_theta=500000.0, tie_embeddings=True), **kw)
+
+
 def llama3_8b(**kw) -> LlamaConfig:
     # Public Llama-3-8B architecture constants.
     return replace(LlamaConfig(
@@ -80,6 +91,7 @@ def llama3_70b(**kw) -> LlamaConfig:
 
 MODEL_CONFIGS = {
     "llama3-tiny": llama3_tiny,
+    "llama3-1b": llama3_1b,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
 }
@@ -247,17 +259,20 @@ def _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens):
     """
     B, T, H, D = q.shape
     S = k_hist.shape[1]
-    n_rep = H // k_hist.shape[2]
-    k = jnp.repeat(k_hist, n_rep, axis=-2)
-    v = jnp.repeat(v_hist, n_rep, axis=-2)
-    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * (D ** -0.5)
+    Hkv = k_hist.shape[2]
+    n_rep = H // Hkv
+    # Grouped-query einsum: no n_rep-fold K/V repeat, bf16 on the MXU
+    # with f32 accumulation (see ops/attention.py rationale).
+    qg = q.reshape(B, T, Hkv, n_rep, D)
+    logits = jnp.einsum("btgrd,bsgd->bgrts", qg, k_hist,
+                        preferred_element_type=jnp.float32) * (D ** -0.5)
     kv_pos = jnp.arange(S)[None, None, :]                  # (1,1,S)
     mask = (kv_pos <= positions[:, :, None]) & (kv_pos < seq_lens[:, None, None])
-    logits = jnp.where(mask[:, None], logits, -1e30)
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bgrts,bsgd->btgrd", probs.astype(v_hist.dtype), v_hist,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -268,9 +283,15 @@ def forward_decode(
     positions: jnp.ndarray,     # (B,) int32 — absolute position of `tokens`
     kv_cache: KVCache,
     block_tables: jnp.ndarray,  # (B, max_pages)
+    active: Optional[jnp.ndarray] = None,  # (B,) bool — inactive rows write to page 0
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step for every active sequence. Returns
-    (logits (B, V) f32, updated cache)."""
+    (logits (B, V) f32, updated cache).
+
+    ``active`` supports multi-step on-device decoding (executor
+    ``decode_chunk``): rows whose sequence already finished inside the
+    chunk scatter their KV to reserved page 0 instead of the real pages.
+    """
     B = tokens.shape[0]
     page_sz = kv_cache["k"].shape[2]
 
@@ -278,6 +299,8 @@ def forward_decode(
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim,
                             cfg.rope_theta)                # (B,1,half)
     page_of = block_tables[jnp.arange(B), positions // page_sz]
+    if active is not None:
+        page_of = jnp.where(active, page_of, 0)
     slot_of = positions % page_sz
     seq_lens = positions + 1
 

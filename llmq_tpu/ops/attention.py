@@ -19,13 +19,6 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    """GQA: repeat KV heads to match query heads. (..., H_kv, D) → (..., H, D)."""
-    if n_rep == 1:
-        return x
-    return jnp.repeat(x, n_rep, axis=-2)
-
-
 def causal_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                              *, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
     """Causal self-attention for prefill.
@@ -34,22 +27,30 @@ def causal_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     previously-cached prefix; ``q_offset`` is the absolute position of
     q's first token, scalar or per-batch (B,)).
     Returns (B, T, H, D). Softmax in f32.
+
+    GQA via grouped einsum — query heads are reshaped to
+    (H_kv groups × n_rep) instead of repeating K/V ``n_rep``× in memory:
+    the MXU consumes bf16 operands directly (f32 accumulation via
+    ``preferred_element_type``), and no (B, S, H, D) f32 copy of the
+    cache is ever materialized — on TPU that repeat+cast costs more HBM
+    traffic than the attention math itself.
     """
     B, T, H, D = q.shape
     S = k.shape[1]
-    n_rep = H // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
+    Hkv = k.shape[2]
+    n_rep = H // Hkv
     scale = D ** -0.5
-    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    qg = q.reshape(B, T, Hkv, n_rep, D)
+    logits = jnp.einsum("btgrd,bsgd->bgrts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     q_pos = jnp.arange(T)[:, None] + jnp.asarray(q_offset).reshape(-1, 1, 1)  # (B|1,T,1)
     kv_pos = jnp.arange(S)[None, None, :]
-    mask = kv_pos <= q_pos  # (B|1, T, S)
-    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    mask = (kv_pos <= q_pos)[:, None, None, :, :]  # (B|1,1,1,T,S)
+    logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bgrts,bsgd->btgrd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 def paged_decode_attention(
@@ -62,23 +63,25 @@ def paged_decode_attention(
     """Single-token decode attention over the paged KV pool.
 
     Gathers each sequence's pages via its block table, masks beyond
-    ``seq_lens`` and runs GQA attention. Returns (B, H, D).
+    ``seq_lens`` and runs GQA attention (grouped einsum, no K/V repeat —
+    see :func:`causal_prefill_attention`). Returns (B, H, D).
     """
     B, H, D = q.shape
     page_size = k_pages.shape[1]
     max_pages = block_tables.shape[1]
     S = max_pages * page_size
+    Hkv = k_pages.shape[2]
+    n_rep = H // Hkv
     # Gather: (B, max_pages, page_size, H_kv, D) → (B, S, H_kv, D)
-    k = k_pages[block_tables].reshape(B, S, -1, D)
-    v = v_pages[block_tables].reshape(B, S, -1, D)
-    n_rep = H // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
+    k = k_pages[block_tables].reshape(B, S, Hkv, D)
+    v = v_pages[block_tables].reshape(B, S, Hkv, D)
     scale = D ** -0.5
-    logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    qg = q.reshape(B, Hkv, n_rep, D)
+    logits = jnp.einsum("bgrd,bsgd->bgrs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(S)[None, :] < seq_lens[:, None]  # (B, S)
-    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bgrs,bsgd->bgrd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, H, D).astype(q.dtype)
