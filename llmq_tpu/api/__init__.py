@@ -1,0 +1,2 @@
+from llmq_tpu.api.message_store import MessageStore  # noqa: F401
+from llmq_tpu.api.server import ApiServer  # noqa: F401

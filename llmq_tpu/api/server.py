@@ -1,0 +1,608 @@
+"""REST API server — route-table parity with the reference Gin server.
+
+Implements every route of reference api/handlers.go:75-118 (stdlib
+``http.server``; no third-party web framework), with these deliberate
+upgrades over the reference:
+
+- ``GET /api/v1/messages[/:id]`` and the admin queue-delete /
+  dead-letter-requeue routes are **implemented** (the reference returns
+  HTTP 501 for all of them, handlers.go:222-256,622-697).
+- ``POST /api/v1/messages`` pushes to the per-tier queue that actually
+  exists. (The reference pushes to a queue named ``fmt.Sprint(priority)``
+  on a manager that only ever created a queue named "standard",
+  handlers.go:202 vs cmd/server/main.go:174 — every submit fails with
+  ErrQueueNotFound at runtime.)
+- ``estimated_wait`` uses measured per-tier queue stats when available,
+  falling back to the reference's fixed table (handlers.go:729-744).
+- Prometheus exposition is actually mounted at ``/metrics`` (the
+  reference configures a metrics port but never mounts promhttp).
+- Admin preprocessor rules are functional, not log-only
+  (handlers.go:560-588).
+
+CORS middleware mirrors handlers.go:121-148 (origin allow-list, ``*``
+wildcard, OPTIONS preflight → 204).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from llmq_tpu import __version__
+from llmq_tpu.api.message_store import MessageStore
+from llmq_tpu.core.config import Config, default_config
+from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
+from llmq_tpu.core.types import (Conversation, ConversationState, Message,
+                                 Priority, new_id)
+from llmq_tpu.preprocessor.preprocessor import analyze_text
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("api")
+
+#: Fallback per-tier wait estimates, seconds (handlers.go:729-744).
+_WAIT_TABLE = {Priority.REALTIME: 1.0, Priority.HIGH: 5.0,
+               Priority.NORMAL: 15.0, Priority.LOW: 30.0}
+
+Handler = Callable[["_Request"], Tuple[int, Any]]
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Request:
+    """Parsed request handed to route handlers."""
+
+    def __init__(self, method: str, path: str, params: Dict[str, str],
+                 query: Dict[str, List[str]], body: bytes) -> None:
+        self.method = method
+        self.path = path
+        self.params = params          # path captures, e.g. {"id": ...}
+        self.query = query
+        self._body = body
+
+    def json(self) -> Dict[str, Any]:
+        if not self._body:
+            raise ApiError(400, "request body required")
+        try:
+            data = json.loads(self._body)
+        except json.JSONDecodeError as e:
+            raise ApiError(400, f"invalid JSON: {e}") from None
+        if not isinstance(data, dict):
+            raise ApiError(400, "JSON object expected")
+        return data
+
+    def q(self, name: str, default: str = "") -> str:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+
+class ApiServer:
+    """Aggregates the L2 services behind the v1 REST contract — the
+    counterpart of the reference APIServer struct (handlers.go:24-34),
+    plus the execution-plane engine the reference lacks."""
+
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        *,
+        queue_factory=None,
+        preprocessor=None,
+        state_manager=None,
+        load_balancer=None,
+        resource_scheduler=None,
+        engine=None,
+        message_store: Optional[MessageStore] = None,
+        allowed_origins: Optional[List[str]] = None,
+        manager_name: str = "standard",
+    ) -> None:
+        self.config = config or default_config()
+        self.factory = queue_factory
+        self.preprocessor = preprocessor
+        self.state_manager = state_manager
+        self.load_balancer = load_balancer
+        self.resource_scheduler = resource_scheduler
+        self.engine = engine
+        self.store = message_store or MessageStore()
+        self.allowed_origins = allowed_origins or ["*"]
+        self.manager_name = manager_name
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._setup_routes()
+
+    # -- routing table (parity: handlers.go:75-118) --------------------------
+
+    def _route(self, method: str, pattern: str, handler: Handler) -> None:
+        # "/api/v1/messages/:id" → named captures
+        rx = re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method, re.compile(f"^{rx}$"), handler))
+
+    def _setup_routes(self) -> None:
+        r = self._route
+        r("GET", "/health", self.health_check)
+        r("GET", "/metrics", self.metrics_exposition)
+        v1 = "/api/v1"
+        r("POST", f"{v1}/messages", self.submit_message)
+        r("GET", f"{v1}/messages/:id", self.get_message)
+        r("GET", f"{v1}/messages", self.list_messages)
+        r("POST", f"{v1}/conversations", self.create_conversation)
+        r("GET", f"{v1}/conversations/:id", self.get_conversation)
+        r("POST", f"{v1}/conversations/:id/messages",
+          self.add_message_to_conversation)
+        r("PUT", f"{v1}/conversations/:id/state",
+          self.update_conversation_state)
+        r("GET", f"{v1}/users/:user_id/conversations",
+          self.list_user_conversations)
+        r("GET", f"{v1}/queues/stats", self.get_queue_stats)
+        r("POST", f"{v1}/resources", self.register_resource)
+        r("GET", f"{v1}/resources", self.list_resources)
+        r("GET", f"{v1}/resources/stats", self.get_resource_stats)
+        r("POST", f"{v1}/endpoints", self.register_endpoint)
+        r("GET", f"{v1}/endpoints", self.list_endpoints)
+        r("GET", f"{v1}/endpoints/stats", self.get_endpoint_stats)
+        r("GET", f"{v1}/engine/stats", self.get_engine_stats)
+        adm = f"{v1}/admin"
+        r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
+        r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
+        r("POST", f"{adm}/preprocessor/user-priorities", self.set_user_priority)
+        r("DELETE", f"{adm}/queues/:queue_type/:id", self.remove_message)
+        r("POST", f"{adm}/dead-letter/requeue/:id",
+          self.requeue_dead_letter_message)
+        r("POST", f"{adm}/dead-letter/requeue-all",
+          self.requeue_all_dead_letter_messages)
+
+    def dispatch(self, method: str, raw_path: str,
+                 body: bytes) -> Tuple[int, Any, str]:
+        """Route one request. Returns (status, payload, content_type)."""
+        parsed = urlparse(raw_path)
+        path = parsed.path.rstrip("/") or "/"
+        query = parse_qs(parsed.query)
+        matched_path = False
+        for m, rx, handler in self._routes:
+            match = rx.match(path)
+            if not match:
+                continue
+            matched_path = True
+            if m != method:
+                continue
+            req = _Request(method, path, match.groupdict(), query, body)
+            try:
+                status, payload = handler(req)
+            except ApiError as e:
+                return e.status, {"error": e.message}, "application/json"
+            except QueueNotFoundError as e:
+                return 404, {"error": str(e)}, "application/json"
+            except QueueFullError as e:
+                return 503, {"error": str(e)}, "application/json"
+            except Exception as e:  # noqa: BLE001
+                log.exception("handler error on %s %s", method, path)
+                return 500, {"error": f"internal error: {e}"}, "application/json"
+            if isinstance(payload, bytes):
+                return status, payload, "text/plain; version=0.0.4"
+            return status, payload, "application/json"
+        if matched_path:
+            return 405, {"error": "method not allowed"}, "application/json"
+        return 404, {"error": "not found"}, "application/json"
+
+    # -- helpers -------------------------------------------------------------
+
+    def _manager(self, name: Optional[str] = None):
+        if self.factory is None:
+            raise ApiError(503, "queue factory not configured")
+        mgr = self.factory.get_queue_manager(name or self.manager_name)
+        if mgr is None:
+            if name:  # client-named manager → not found
+                raise ApiError(404, f"no queue manager named {name!r}")
+            raise ApiError(500, "failed to access message queue")
+        return mgr
+
+    def _require_state_manager(self):
+        if self.state_manager is None:
+            raise ApiError(503, "conversation service not configured")
+        return self.state_manager
+
+    def estimate_wait(self, priority: Priority) -> float:
+        """Measured per-tier estimate (avg wait scaled by backlog) with the
+        reference's fixed table as a cold-start fallback."""
+        fallback = _WAIT_TABLE.get(priority, 15.0)
+        if self.factory is None:
+            return fallback
+        mgr = self.factory.get_queue_manager(self.manager_name)
+        if mgr is None:
+            return fallback
+        try:
+            stats = mgr.get_stats(priority.tier_name)
+        except QueueNotFoundError:
+            return fallback
+        if stats.wait_samples == 0:
+            return fallback
+        backlog_factor = 1.0 + stats.pending_count / max(
+            1, stats.completed_count + stats.processing_count)
+        return round(stats.avg_wait_time * backlog_factor, 4)
+
+    def _ingest_message(self, data: Dict[str, Any],
+                        conversation_id: str = "") -> Message:
+        """Shared submit pipeline: parse → id/timestamps → preprocess →
+        analysis metadata → push → conversation update → store."""
+        try:
+            msg = Message.from_dict(data)
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"invalid message: {e}") from None
+        if conversation_id:
+            msg.conversation_id = conversation_id
+        if not msg.id:
+            msg.id = new_id()
+        now = time.time()
+        msg.created_at = now
+        msg.updated_at = now
+        if self.preprocessor is not None:
+            msg = self.preprocessor.process_message(msg)
+            if self.preprocessor.enable_content_analysis:
+                # Reference stores the analysis as a JSON string under
+                # metadata["analysis"] (handlers.go:181-191 — gated there
+                # on the unrelated EnableMetrics flag; we gate on the
+                # preprocessor's own content-analysis switch).
+                msg.metadata["analysis"] = json.dumps(
+                    analyze_text(msg.content))
+        mgr = self._manager()
+        mgr.push_message(msg)
+        self.store.record(msg)
+        if msg.conversation_id and self.state_manager is not None:
+            try:
+                self.state_manager.get_or_create(msg.conversation_id,
+                                                 msg.user_id)
+                self.state_manager.add_message(msg.conversation_id, msg)
+            except Exception:  # noqa: BLE001 — parity: log, don't fail submit
+                log.exception("conversation update failed for %s", msg.id)
+        return msg
+
+    # -- handlers ------------------------------------------------------------
+
+    def health_check(self, req: _Request) -> Tuple[int, Any]:
+        out = {"status": "ok", "version": __version__, "time": time.time()}
+        if self.engine is not None:
+            out["engine"] = "running" if self.engine.running else "stopped"
+        return 200, out
+
+    def metrics_exposition(self, req: _Request) -> Tuple[int, Any]:
+        from llmq_tpu.metrics.registry import exposition
+        return 200, exposition()
+
+    def submit_message(self, req: _Request) -> Tuple[int, Any]:
+        msg = self._ingest_message(req.json())
+        return 202, {
+            "message_id": msg.id,
+            "priority": int(msg.priority),
+            "queue_time": time.time(),
+            "estimated_wait": self.estimate_wait(msg.priority),
+        }
+
+    def get_message(self, req: _Request) -> Tuple[int, Any]:
+        msg = self.store.get(req.params["id"])
+        if msg is None:
+            return 404, {"error": "message not found"}
+        return 200, msg.to_dict()
+
+    def list_messages(self, req: _Request) -> Tuple[int, Any]:
+        try:
+            limit = int(req.q("limit", "10"))
+            offset = int(req.q("offset", "0"))
+        except ValueError:
+            raise ApiError(400, "limit/offset must be integers") from None
+        msgs = self.store.list(
+            user_id=req.q("user_id"),
+            conversation_id=req.q("conversation_id"),
+            status=req.q("status"),
+            limit=limit, offset=offset)
+        return 200, {"messages": [m.to_dict() for m in msgs],
+                     "count": len(msgs)}
+
+    def create_conversation(self, req: _Request) -> Tuple[int, Any]:
+        data = req.json()
+        user_id = data.get("user_id")
+        if not user_id:
+            raise ApiError(400, "user_id is required")
+        sm = self._require_state_manager()
+        conv = sm.create(user_id, metadata=data.get("metadata") or {})
+        return 201, {
+            "conversation_id": conv.id,
+            "user_id": conv.user_id,
+            "created_at": conv.created_at,
+            "state": conv.state.value,
+        }
+
+    def get_conversation(self, req: _Request) -> Tuple[int, Any]:
+        sm = self._require_state_manager()
+        try:
+            conv = sm.get(req.params["id"])
+        except KeyError:
+            return 404, {"error": "conversation not found"}
+        return 200, conv.to_dict()
+
+    def add_message_to_conversation(self, req: _Request) -> Tuple[int, Any]:
+        conv_id = req.params["id"]
+        msg = self._ingest_message(req.json(), conversation_id=conv_id)
+        return 202, {
+            "message_id": msg.id,
+            "conversation_id": conv_id,
+            "priority": int(msg.priority),
+            "queue_time": time.time(),
+            "estimated_wait": self.estimate_wait(msg.priority),
+        }
+
+    def update_conversation_state(self, req: _Request) -> Tuple[int, Any]:
+        data = req.json()
+        state = data.get("state")
+        if not state:
+            raise ApiError(400, "state is required")
+        try:
+            new_state = ConversationState(state)
+        except ValueError:
+            raise ApiError(
+                400, f"invalid state {state!r}; valid: "
+                f"{[s.value for s in ConversationState]}") from None
+        sm = self._require_state_manager()
+        try:
+            sm.update_state(req.params["id"], new_state)
+        except KeyError:
+            return 404, {"error": "conversation not found"}
+        return 200, {"status": "updated"}
+
+    def list_user_conversations(self, req: _Request) -> Tuple[int, Any]:
+        sm = self._require_state_manager()
+        convs = sm.user_conversations(req.params["user_id"])
+        return 200, {"conversations": [c.to_dict(include_messages=False)
+                                       for c in convs]}
+
+    def get_queue_stats(self, req: _Request) -> Tuple[int, Any]:
+        if self.factory is None:
+            raise ApiError(503, "queue factory not configured")
+        stats: Dict[str, Any] = {}
+        for name in self.factory.manager_names():
+            mgr = self.factory.get_queue_manager(name)
+            if mgr is None:
+                continue
+            stats[name] = {qn: s.to_dict()
+                           for qn, s in mgr.get_all_stats().items()}
+            stats[name]["workers"] = self.factory.get_worker_stats(name)
+            dlq = self.factory.get_dead_letter_queue(name)
+            if dlq is not None:
+                stats[name]["dead_letter_size"] = dlq.size()
+        return 200, stats
+
+    def register_resource(self, req: _Request) -> Tuple[int, Any]:
+        if self.resource_scheduler is None:
+            raise ApiError(503, "resource scheduler not configured")
+        from llmq_tpu.scheduling.resource_scheduler import (Resource,
+                                                            ResourceStatus,
+                                                            ResourceType)
+        data = req.json()
+        try:
+            capacity = {ResourceType(k): float(v)
+                        for k, v in (data.get("capacity") or {}).items()}
+            res = Resource(
+                id=data.get("id") or new_id(),
+                model_type=data.get("model_type", "llm"),
+                capabilities=set(data.get("capabilities") or []),
+                capacity=capacity,
+                endpoint=data.get("endpoint", ""),
+                status=ResourceStatus(data.get("status", "online")),
+                metadata=data.get("metadata") or {},
+            )
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"invalid resource: {e}") from None
+        self.resource_scheduler.register_resource(res)
+        return 201, {"resource_id": res.id, "status": res.status.value}
+
+    def list_resources(self, req: _Request) -> Tuple[int, Any]:
+        if self.resource_scheduler is None:
+            raise ApiError(503, "resource scheduler not configured")
+        return 200, {"resources": [r.to_dict()
+                                   for r in self.resource_scheduler.resources()]}
+
+    def get_resource_stats(self, req: _Request) -> Tuple[int, Any]:
+        if self.resource_scheduler is None:
+            raise ApiError(503, "resource scheduler not configured")
+        return 200, self.resource_scheduler.get_stats()
+
+    def register_endpoint(self, req: _Request) -> Tuple[int, Any]:
+        if self.load_balancer is None:
+            raise ApiError(503, "load balancer not configured")
+        from llmq_tpu.loadbalancer.load_balancer import (Endpoint,
+                                                         EndpointStatus)
+        data = req.json()
+        try:
+            ep = Endpoint(
+                id=data.get("id") or new_id(),
+                name=data.get("name", ""),
+                url=data.get("url", ""),
+                model_type=data.get("model_type", "llm"),
+                weight=float(data.get("weight", 1.0)),
+                max_connections=int(data.get("max_connections", 0)),
+                status=EndpointStatus(data.get("status", "healthy")),
+                metadata=data.get("metadata") or {},
+            )
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"invalid endpoint: {e}") from None
+        self.load_balancer.add_endpoint(ep)
+        return 201, {"endpoint_id": ep.id, "status": ep.status.value}
+
+    def list_endpoints(self, req: _Request) -> Tuple[int, Any]:
+        if self.load_balancer is None:
+            raise ApiError(503, "load balancer not configured")
+        return 200, {"endpoints": [e.to_dict()
+                                   for e in self.load_balancer.endpoints()]}
+
+    def get_endpoint_stats(self, req: _Request) -> Tuple[int, Any]:
+        if self.load_balancer is None:
+            raise ApiError(503, "load balancer not configured")
+        return 200, self.load_balancer.get_stats()
+
+    def get_engine_stats(self, req: _Request) -> Tuple[int, Any]:
+        if self.engine is None:
+            raise ApiError(503, "engine not configured")
+        return 200, self.engine.get_stats()
+
+    # -- admin ---------------------------------------------------------------
+
+    def add_priority_rule(self, req: _Request) -> Tuple[int, Any]:
+        if self.preprocessor is None:
+            raise ApiError(503, "preprocessor not configured")
+        data = req.json()
+        pattern = data.get("pattern")
+        if not pattern:
+            raise ApiError(400, "pattern is required")
+        try:
+            priority = Priority.parse(data.get("priority", "normal"))
+        except (ValueError, TypeError):
+            raise ApiError(400, f"invalid priority {data.get('priority')!r}") \
+                from None
+        try:
+            rule = self.preprocessor.add_rule(pattern, priority,
+                                              name=data.get("name", ""))
+        except re.error as e:
+            raise ApiError(400, f"invalid pattern: {e}") from None
+        return 201, {"status": "rule added", "rule": rule.to_dict()}
+
+    def list_priority_rules(self, req: _Request) -> Tuple[int, Any]:
+        if self.preprocessor is None:
+            raise ApiError(503, "preprocessor not configured")
+        return 200, {"rules": [r.to_dict()
+                               for r in self.preprocessor.list_rules()]}
+
+    def set_user_priority(self, req: _Request) -> Tuple[int, Any]:
+        if self.preprocessor is None:
+            raise ApiError(503, "preprocessor not configured")
+        data = req.json()
+        user_id = data.get("user_id")
+        prio_raw = data.get("priority")
+        if not user_id or prio_raw is None:
+            raise ApiError(400, "user_id and priority are required")
+        try:
+            priority = Priority.parse(prio_raw)
+        except (ValueError, TypeError):
+            # Parity: the reference silently maps unknown names to normal
+            # (handlers.go:600-612); we reject instead.
+            raise ApiError(400, f"invalid priority {prio_raw!r}") from None
+        self.preprocessor.set_user_priority(user_id, priority)
+        return 200, {"status": "user priority set"}
+
+    def remove_message(self, req: _Request) -> Tuple[int, Any]:
+        mgr = self._manager(req.params["queue_type"])
+        msg = mgr.remove_message(req.params["id"])
+        if msg is None:
+            return 404, {"error": "no pending message with that id"}
+        return 200, {"status": "removed", "message_id": msg.id}
+
+    def requeue_dead_letter_message(self, req: _Request) -> Tuple[int, Any]:
+        if self.factory is None:
+            raise ApiError(503, "queue factory not configured")
+        name = req.q("manager", self.manager_name)
+        dlq = self.factory.get_dead_letter_queue(name)
+        if dlq is None:
+            raise ApiError(404, f"no dead-letter queue for manager {name!r}")
+        mgr = self._manager(name)
+        try:
+            msg = dlq.requeue(req.params["id"], mgr)
+        except KeyError:
+            return 404, {"error": "message not in dead-letter queue"}
+        return 200, {"status": "requeued", "message_id": msg.id}
+
+    def requeue_all_dead_letter_messages(self, req: _Request) -> Tuple[int, Any]:
+        if self.factory is None:
+            raise ApiError(503, "queue factory not configured")
+        name = req.q("manager", self.manager_name)
+        dlq = self.factory.get_dead_letter_queue(name)
+        if dlq is None:
+            raise ApiError(404, f"no dead-letter queue for manager {name!r}")
+        mgr = self._manager(name)
+        requeued = dlq.batch_requeue(mgr)
+        return 200, {"status": "requeued", "count": len(requeued)}
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class _HTTPHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self) -> None:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload, ctype = server.dispatch(
+                    self.command, self.path, body)
+                data = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self._cors_headers()
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _cors_headers(self) -> None:
+                origin = self.headers.get("Origin", "")
+                if not origin:
+                    return
+                exact = origin in server.allowed_origins
+                if exact or "*" in server.allowed_origins:
+                    self.send_header("Access-Control-Allow-Origin", origin)
+                    self.send_header("Access-Control-Allow-Methods",
+                                     "GET, POST, PUT, DELETE, OPTIONS")
+                    self.send_header("Access-Control-Allow-Headers",
+                                     "Content-Type, Authorization")
+                    # Credentials only for an explicitly allow-listed
+                    # origin — never for the wildcard (the reference
+                    # reflects any origin WITH credentials,
+                    # handlers.go:121-148; that combination lets any
+                    # site ride a browser's session).
+                    if exact:
+                        self.send_header("Access-Control-Allow-Credentials",
+                                         "true")
+
+            def do_OPTIONS(self) -> None:  # noqa: N802 — preflight → 204
+                self.send_response(204)
+                self._cors_headers()
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            do_GET = do_POST = do_PUT = do_DELETE = _respond  # noqa: N815
+
+            def log_message(self, fmt: str, *args) -> None:
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+        return _HTTPHandler
+
+    def start(self, host: Optional[str] = None,
+              port: Optional[int] = None) -> int:
+        """Serve in a background thread. Returns the bound port (useful
+        with port=0 in tests)."""
+        host = host if host is not None else self.config.server.host
+        port = port if port is not None else self.config.server.port
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="api-server", daemon=True)
+        self._thread.start()
+        bound = self._httpd.server_address[1]
+        log.info("API server listening on %s:%d", host, bound)
+        return bound
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

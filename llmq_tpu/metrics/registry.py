@@ -99,4 +99,5 @@ def get_metrics() -> QueueMetrics:
 
 def exposition() -> bytes:
     """Prometheus text exposition for the API server's /metrics route."""
+    get_metrics()  # ensure the families exist even before first increment
     return generate_latest(REGISTRY)

@@ -93,6 +93,9 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.mlq_fail.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
         lib.mlq_requeue_accounting.restype = ctypes.c_int64
         lib.mlq_requeue_accounting.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.mlq_discard.restype = ctypes.c_int64
+        lib.mlq_discard.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
         lib.mlq_stats.restype = ctypes.c_int64
         lib.mlq_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.c_int64),
@@ -168,6 +171,9 @@ class NativeMLQ:
 
     def requeue_accounting(self, name: str) -> int:
         return self._lib.mlq_requeue_accounting(self._h, name.encode())
+
+    def discard(self, name: str, handle: int) -> int:
+        return self._lib.mlq_discard(self._h, name.encode(), handle)
 
     def stats(self, name: str) -> Tuple[int, List[int], List[float]]:
         out_i = (ctypes.c_int64 * 5)()

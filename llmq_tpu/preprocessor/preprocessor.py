@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import re
 import threading
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from llmq_tpu.core.types import Message, Priority
@@ -53,11 +54,52 @@ _REALTIME_PATTERNS = _compile(REALTIME_KEYWORDS)
 _HIGH_PATTERNS = _compile(HIGH_KEYWORDS)
 
 
+@dataclass
+class PriorityRule:
+    """An admin-registered content rule: messages whose content matches
+    ``pattern`` get ``priority``. Implements for real what the reference
+    only logs ("Priority rule would be added", handlers.go:560-578)."""
+
+    name: str
+    pattern: str
+    priority: Priority
+    compiled: re.Pattern = field(repr=False, default=None)  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        self.priority = Priority.parse(self.priority)
+        self.compiled = re.compile(self.pattern, re.IGNORECASE)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "pattern": self.pattern,
+                "priority": self.priority.tier_name}
+
+
 class Preprocessor:
     def __init__(self, enable_content_analysis: bool = True) -> None:
         self.enable_content_analysis = enable_content_analysis
         self._user_priorities: Dict[str, Priority] = {}
+        self._rules: List[PriorityRule] = []
         self._mu = threading.RLock()
+
+    # -- admin rules (real version of handlers.go:560-588's TODOs) ----------
+
+    def add_rule(self, pattern: str, priority: Priority,
+                 name: str = "") -> PriorityRule:
+        rule = PriorityRule(name=name or f"rule-{pattern[:24]}",
+                            pattern=pattern, priority=priority)
+        with self._mu:
+            self._rules.append(rule)
+        return rule
+
+    def list_rules(self) -> List[PriorityRule]:
+        with self._mu:
+            return list(self._rules)
+
+    def remove_rule(self, name: str) -> bool:
+        with self._mu:
+            n = len(self._rules)
+            self._rules = [r for r in self._rules if r.name != name]
+            return len(self._rules) != n
 
     # -- user defaults (preprocessor.go:171-173) ----------------------------
 
@@ -99,7 +141,14 @@ class Preprocessor:
             user_default = self._user_priorities.get(message.user_id)
         if user_default is not None:
             return user_default
-        # 4. keyword scoring (:117-168)
+        # 4. admin content rules (most urgent match wins) — slotted above
+        # keyword scoring so operators can override the built-in lexicon.
+        with self._mu:
+            rules = list(self._rules)
+        hits = [r.priority for r in rules if r.compiled.search(message.content)]
+        if hits:
+            return min(hits)
+        # 5. keyword scoring (:117-168)
         return self._analyze_priority(message.content)
 
     @staticmethod

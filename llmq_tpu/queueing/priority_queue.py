@@ -166,6 +166,22 @@ class _PyBackend:
                 s[1] -= 1
             return 0
 
+    def discard(self, name: str, handle: int) -> int:
+        """Remove a pending item by handle with no wait/failed accounting
+        (admin deletion). Mirrors mlq_discard in mlq.cpp."""
+        with self._mu:
+            heap = self._heaps.get(name)
+            if heap is None:
+                return self.ERR_NOT_FOUND
+            for i, item in enumerate(heap):
+                if item[2] == handle:
+                    heap[i] = heap[-1]
+                    heap.pop()
+                    heapq.heapify(heap)
+                    self._stats[name][0] -= 1
+                    return 0
+            return self.ERR_EMPTY
+
     def stats(self, name: str) -> Tuple[int, List[int], List[float]]:
         with self._mu:
             s = self._stats.get(name)
@@ -369,6 +385,32 @@ class MultiLevelQueue:
         err = self._core.requeue_accounting(name)
         if err == self.ERR_NOT_FOUND:
             raise QueueNotFoundError(name)
+
+    def remove_message(self, name: str, message_id: str) -> Optional[Message]:
+        """Remove a PENDING message by id (the admin delete the reference
+        501-stubs, handlers.go:622-658). Unlike expiry tombstones, this
+        eagerly discards the heap entry with no wait-sample or failed
+        accounting — an admin deletion is not a failure and must not
+        skew avg_wait. Returns the message, or None if no pending
+        message has that id."""
+        if not self.has_queue(name):
+            raise QueueNotFoundError(name)
+        with self._mu:
+            target = None
+            for h, (qn, msg, _) in self._messages.items():
+                if qn == name and msg.id == message_id and h not in self._tombstones:
+                    target = (h, msg)
+                    break
+        if target is None:
+            return None
+        h, msg = target
+        if self._core.discard(name, h) != 0:
+            return None  # already popped by a concurrent consumer
+        with self._mu:
+            self._messages.pop(h, None)
+        msg.status = MessageStatus.FAILED
+        msg.error = "removed by admin"
+        return msg
 
     # -- stale cleanup (real version of queue_manager.go:549-553) ------------
 

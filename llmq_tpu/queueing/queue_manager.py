@@ -254,6 +254,24 @@ class QueueManager:
             self._op_metric("retry_stash", "success")
         return qname
 
+    def remove_message(self, message_id: str,
+                       queue_name: Optional[str] = None) -> Optional[Message]:
+        """Admin removal of a pending message by id (implements the
+        reference's 501 stub, handlers.go:622-658). Searches one queue or
+        all of this manager's queues."""
+        names = [queue_name] if queue_name else self.queue_names()
+        for qname in names:
+            msg = self.queue.remove_message(qname, message_id)
+            if msg is not None:
+                with self._inflight_mu:
+                    self._inflight.pop(message_id, None)
+                if self._metrics:
+                    lbl = (self.name, qname, msg.priority.tier_name)
+                    self._metrics.pending.labels(*lbl).dec()
+                    self._op_metric("remove", "success")
+                return msg
+        return None
+
     def _pop_inflight(self, message_id: str) -> Optional[str]:
         with self._inflight_mu:
             return self._inflight.pop(message_id, None)

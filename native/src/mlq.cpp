@@ -207,6 +207,33 @@ int64_t mlq_fail(void* h, const char* name, double process_time) {
   return 0;
 }
 
+// Remove a PENDING item by handle (admin deletion). Unlike the
+// tombstone path, this touches no wait/processing/failed accounting —
+// the item simply leaves pending. O(n) heap rebuild; admin-rate only.
+int64_t mlq_discard(void* h, const char* name, uint64_t handle) {
+  MLQ* q = static_cast<MLQ*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  auto it = q->queues.find(name);
+  if (it == q->queues.end()) return ERR_NOT_FOUND;
+  Queue& qq = it->second;
+  std::vector<Item> keep;
+  keep.reserve(qq.heap.size());
+  bool found = false;
+  while (!qq.heap.empty()) {
+    const Item& top = qq.heap.top();
+    if (!found && top.handle == handle) {
+      found = true;
+    } else {
+      keep.push_back(top);
+    }
+    qq.heap.pop();
+  }
+  for (const Item& item : keep) qq.heap.push(item);
+  if (!found) return ERR_EMPTY;
+  qq.stats.pending -= 1;
+  return 0;
+}
+
 // Re-enqueue accounting for retries: a popped (processing) message goes
 // back to pending without counting as completed/failed.
 int64_t mlq_requeue_accounting(void* h, const char* name) {
