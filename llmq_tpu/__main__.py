@@ -1,0 +1,275 @@
+"""Runnable entrypoints: ``python -m llmq_tpu <command>``.
+
+The reference ships four binaries under ``cmd/`` (server, api-gateway,
+queue-manager, scheduler — cmd/server/main.go:26-119,
+cmd/queue-manager/main.go:73-84, cmd/scheduler/main.go). Here they are
+subcommands of one module sharing one wiring function, which also fixes
+the reference's architectural split-brain: its api-gateway and
+queue-manager each build *independent in-process queues*
+(cmd/api-gateway/main.go:66, cmd/queue-manager/main.go:58), so in the
+compose deployment the consumer never sees the producer's messages
+(SURVEY.md §5 "Distributed communication backend"). Our gateway and
+consumer modes are explicit single-process slices of the same monolith
+wiring instead.
+
+Commands:
+
+- ``serve``          — the monolith: config → queues → workers → engine →
+                       conversation service → API server; graceful
+                       shutdown on SIGINT/SIGTERM (main.go:109-118).
+                       Unlike the reference, workers are actually created
+                       (its startWorkers leaves a TODO, main.go:172-193).
+- ``queue-manager``  — consumer daemon: queues + workers + engine, no
+                       HTTP. The per-tier simulated sleep the reference
+                       runs here (main.go:139-153) is replaced by the
+                       real continuous-batching engine.
+- ``gateway``        — API server + queues only (no workers/engine): the
+                       producer edge.
+- ``scheduler``      — autoscaler monitor loop over the load balancer
+                       (cmd/scheduler/main.go:68-76).
+- ``check``          — load config, build everything, run one echo
+                       request end-to-end, exit. CI smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from llmq_tpu.core.config import Config, load_config
+from llmq_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("main")
+
+
+class App:
+    """One wired process. Which parts exist depends on the mode flags."""
+
+    def __init__(self, cfg: Config, *, with_api: bool, with_workers: bool,
+                 with_engine: bool, with_scheduler: bool = False) -> None:
+        from llmq_tpu.api import ApiServer, MessageStore
+        from llmq_tpu.conversation.persistence import make_store
+        from llmq_tpu.conversation.state_manager import StateManager
+        from llmq_tpu.loadbalancer.load_balancer import LoadBalancer
+        from llmq_tpu.preprocessor.preprocessor import Preprocessor
+        from llmq_tpu.queueing.factory import QueueFactory, QueueType
+        from llmq_tpu.scheduling.autoscaler import Autoscaler
+        from llmq_tpu.scheduling.resource_scheduler import ResourceScheduler
+
+        self.cfg = cfg
+        self.factory = QueueFactory(cfg)
+        # The reference monolith creates standard/delayed/priority
+        # managers (cmd/server/main.go:172-193).
+        self.factory.create_queue_manager("standard", QueueType.STANDARD)
+        self.factory.create_queue_manager("delayed", QueueType.DELAYED)
+        self.factory.create_queue_manager("priority", QueueType.PRIORITY)
+
+        self.preprocessor = Preprocessor()
+        store = make_store(cfg.persistence.backend,
+                           sqlite_path=cfg.persistence.sqlite_path,
+                           redis_url=cfg.persistence.redis_url,
+                           key_prefix=cfg.persistence.key_prefix)
+        self.state_manager = StateManager(cfg.conversation, store=store)
+        self.load_balancer = LoadBalancer(cfg.loadbalancer)
+        self.resource_scheduler = ResourceScheduler(cfg.resource_scheduler)
+
+        self.engine = None
+        if with_engine:
+            from llmq_tpu.engine import build_engine
+            self.engine = build_engine(cfg, warmup=(cfg.executor.backend == "jax"))
+            # BASELINE config #3: conversation eviction frees pinned KV.
+            self.engine.attach_conversation_manager(self.state_manager)
+
+        self.workers: List = []
+        if with_workers:
+            if self.engine is None:
+                raise ValueError("workers need an engine (use --backend echo "
+                                 "for a model-free process)")
+            self.workers = self.factory.create_workers(
+                "standard", cfg.queue.worker.count, self.engine.process_fn)
+
+        self.api: Optional[ApiServer] = None
+        if with_api:
+            self.api = ApiServer(
+                cfg,
+                queue_factory=self.factory,
+                preprocessor=self.preprocessor,
+                state_manager=self.state_manager,
+                load_balancer=self.load_balancer,
+                resource_scheduler=self.resource_scheduler,
+                engine=self.engine,
+                message_store=MessageStore(),
+            )
+
+        self.autoscaler = None
+        if with_scheduler:
+            mgr = self.factory.get_queue_manager("standard")
+            self.autoscaler = Autoscaler(mgr, self.load_balancer,
+                                         cfg.scheduler)
+
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.state_manager.start()
+        self.resource_scheduler.start()
+        if self.cfg.loadbalancer.health_check_interval > 0:
+            self.load_balancer.start()
+        if self.engine is not None:
+            self.engine.start()
+        for w in self.workers:
+            w.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        if self.api is not None:
+            port = self.api.start()
+            log.info("serving on %s:%d", self.cfg.server.host, port)
+
+    def stop(self) -> None:
+        """Shutdown cascade mirroring cmd/server/main.go:109-118."""
+        log.info("shutting down ...")
+        if self.api is not None:
+            self.api.stop()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.factory.stop_all()
+        if self.engine is not None:
+            self.engine.stop()
+        self.load_balancer.stop()
+        self.resource_scheduler.stop()
+        self.state_manager.stop()
+        self._stop.set()
+
+    def wait(self) -> None:
+        """Block until SIGINT/SIGTERM."""
+        signal.signal(signal.SIGINT, lambda *a: self._stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: self._stop.set())
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(0.5)
+        except KeyboardInterrupt:
+            pass
+
+
+def _load(args) -> Config:
+    cfg = load_config(args.config) if args.config else load_config()
+    if args.host:
+        cfg.server.host = args.host
+    if args.port is not None:
+        cfg.server.port = args.port
+    if args.backend:
+        cfg.executor.backend = args.backend
+    configure_logging(cfg.logging.level, cfg.logging.format,
+                      cfg.logging.output)
+    return cfg
+
+
+def cmd_serve(args) -> int:
+    cfg = _load(args)
+    app = App(cfg, with_api=True, with_workers=True, with_engine=True,
+              with_scheduler=True)
+    app.start()
+    app.wait()
+    app.stop()
+    return 0
+
+
+def cmd_queue_manager(args) -> int:
+    cfg = _load(args)
+    app = App(cfg, with_api=False, with_workers=True, with_engine=True)
+    app.start()
+    log.info("queue-manager consuming with %d workers (%s engine)",
+             len(app.workers), cfg.executor.backend)
+    app.wait()
+    app.stop()
+    return 0
+
+
+def cmd_gateway(args) -> int:
+    cfg = _load(args)
+    app = App(cfg, with_api=True, with_workers=False, with_engine=False)
+    app.start()
+    app.wait()
+    app.stop()
+    return 0
+
+
+def cmd_scheduler(args) -> int:
+    cfg = _load(args)
+    app = App(cfg, with_api=False, with_workers=False, with_engine=False,
+              with_scheduler=True)
+    app.start()
+    log.info("scheduler monitoring (strategy=%s)", cfg.scheduler.strategy)
+    app.wait()
+    app.stop()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Build the full monolith, run one message end-to-end, exit 0/1."""
+    cfg = _load(args)
+    cfg.executor.backend = args.backend or "echo"
+    app = App(cfg, with_api=True, with_workers=True, with_engine=True)
+    # Ephemeral port so a parallel real instance doesn't collide.
+    cfg.server.port = 0
+    app.start()
+    ok = False
+    try:
+        import json
+        import urllib.request
+        port = app.api._httpd.server_address[1]  # noqa: SLF001
+        body = json.dumps({"content": "smoke check", "user_id": "check"}
+                          ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/messages", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            mid = json.loads(resp.read())["message_id"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/messages/{mid}",
+                    timeout=10) as resp:
+                m = json.loads(resp.read())
+            if m["status"] == "completed":
+                ok = bool(m["response"])
+                break
+            time.sleep(0.05)
+    finally:
+        app.stop()
+    print("CHECK OK" if ok else "CHECK FAILED")
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="llmq_tpu",
+        description="TPU-native LLM message queue + serving framework")
+    parser.add_argument("--config", "-c", help="config YAML path")
+    parser.add_argument("--host", help="override server.host")
+    parser.add_argument("--port", type=int, help="override server.port")
+    parser.add_argument("--backend", choices=["echo", "jax"],
+                        help="override executor.backend")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("serve", help="monolith: API + workers + engine")
+    sub.add_parser("queue-manager", help="consumer daemon (no HTTP)")
+    sub.add_parser("gateway", help="API edge (no workers/engine)")
+    sub.add_parser("scheduler", help="autoscaler monitor loop")
+    sub.add_parser("check", help="end-to-end smoke check, then exit")
+    args = parser.parse_args(argv)
+    return {
+        "serve": cmd_serve,
+        "queue-manager": cmd_queue_manager,
+        "gateway": cmd_gateway,
+        "scheduler": cmd_scheduler,
+        "check": cmd_check,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,9 +37,8 @@ from llmq_tpu import __version__
 from llmq_tpu.api.message_store import MessageStore
 from llmq_tpu.core.config import Config, default_config
 from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
-from llmq_tpu.core.types import (Conversation, ConversationState, Message,
-                                 Priority, new_id)
-from llmq_tpu.preprocessor.preprocessor import analyze_text
+from llmq_tpu.core.types import (ConversationState, Message, Priority,
+                                 new_id)
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("api")
@@ -250,16 +249,19 @@ class ApiServer:
                 # Reference stores the analysis as a JSON string under
                 # metadata["analysis"] (handlers.go:181-191 — gated there
                 # on the unrelated EnableMetrics flag; we gate on the
-                # preprocessor's own content-analysis switch).
+                # preprocessor's own switch and reuse the keys
+                # process_message already annotated instead of running
+                # the regex pass twice).
                 msg.metadata["analysis"] = json.dumps(
-                    analyze_text(msg.content))
+                    {k: msg.metadata[k]
+                     for k in ("word_count", "char_count", "sentiment",
+                               "is_question") if k in msg.metadata})
         mgr = self._manager()
         mgr.push_message(msg)
         self.store.record(msg)
         if msg.conversation_id and self.state_manager is not None:
             try:
-                self.state_manager.get_or_create(msg.conversation_id,
-                                                 msg.user_id)
+                # add_message get-or-creates the conversation itself.
                 self.state_manager.add_message(msg.conversation_id, msg)
             except Exception:  # noqa: BLE001 — parity: log, don't fail submit
                 log.exception("conversation update failed for %s", msg.id)
@@ -541,8 +543,15 @@ class ApiServer:
                 body = self.rfile.read(length) if length else b""
                 status, payload, ctype = server.dispatch(
                     self.command, self.path, body)
-                data = (payload if isinstance(payload, bytes)
-                        else json.dumps(payload).encode())
+                try:
+                    data = (payload if isinstance(payload, bytes)
+                            else json.dumps(payload).encode())
+                except (TypeError, ValueError, RuntimeError) as e:
+                    log.exception("response serialization failed")
+                    status = 500
+                    ctype = "application/json"
+                    data = json.dumps(
+                        {"error": f"serialization error: {e}"}).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -557,6 +566,10 @@ class ApiServer:
                 exact = origin in server.allowed_origins
                 if exact or "*" in server.allowed_origins:
                     self.send_header("Access-Control-Allow-Origin", origin)
+                    # The allow-origin value varies per request; caches
+                    # must key on Origin or they serve one origin's CORS
+                    # headers to another.
+                    self.send_header("Vary", "Origin")
                     self.send_header("Access-Control-Allow-Methods",
                                      "GET, POST, PUT, DELETE, OPTIONS")
                     self.send_header("Access-Control-Allow-Headers",

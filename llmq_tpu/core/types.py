@@ -148,7 +148,10 @@ class Message:
             "created_at": self.created_at,
             "updated_at": self.updated_at,
             "scheduled_at": self.scheduled_at,
-            "metadata": self.metadata,
+            # Shallow copy: callers serialize this while the execution
+            # plane may still be inserting keys (e.g. "usage") — handing
+            # out the live dict makes json.dumps race with that insert.
+            "metadata": dict(self.metadata),
             "response": self.response,
             "error": self.error,
         }
@@ -186,7 +189,7 @@ class Conversation:
             "created_at": self.created_at,
             "updated_at": self.updated_at,
             "last_active_at": self.last_active_at,
-            "metadata": self.metadata,
+            "metadata": dict(self.metadata),
             "message_count": len(self.messages),
         }
         if include_messages:

@@ -1,0 +1,87 @@
+"""Entrypoint wiring tests (``python -m llmq_tpu``).
+
+The reference's monolith leaves worker creation as a TODO
+(cmd/server/main.go:172-193) and its gateway/consumer build disjoint
+in-process queues; these tests pin down that our wiring actually drains
+what it accepts."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from llmq_tpu.__main__ import App, main
+from llmq_tpu.core.config import default_config
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_monolith_serves_and_drains():
+    cfg = default_config()
+    cfg.server.host = "127.0.0.1"
+    cfg.server.port = 0
+    cfg.queue.enable_metrics = False
+    cfg.queue.worker.process_interval = 0.005
+    cfg.loadbalancer.health_check_interval = 0.0
+    app = App(cfg, with_api=True, with_workers=True, with_engine=True,
+              with_scheduler=True)
+    app.start()
+    try:
+        port = app.api._httpd.server_address[1]
+        out = _post(port, "/api/v1/messages",
+                    {"content": "end to end", "user_id": "t"})
+        mid = out["message_id"]
+        deadline = time.time() + 15
+        status = ""
+        while time.time() < deadline:
+            m = _get(port, f"/api/v1/messages/{mid}")
+            status = m["status"]
+            if status == "completed":
+                break
+            time.sleep(0.02)
+        assert status == "completed"
+        assert m["response"]
+        # Monolith created the reference's three managers.
+        stats = _get(port, "/api/v1/queues/stats")
+        assert {"standard", "delayed", "priority"} <= set(stats)
+    finally:
+        app.stop()
+
+
+def test_consumer_daemon_drains_without_api():
+    from llmq_tpu.core.types import Message
+
+    cfg = default_config()
+    cfg.queue.enable_metrics = False
+    cfg.queue.worker.process_interval = 0.005
+    cfg.loadbalancer.health_check_interval = 0.0
+    app = App(cfg, with_api=False, with_workers=True, with_engine=True)
+    app.start()
+    try:
+        assert app.api is None
+        mgr = app.factory.get_queue_manager("standard")
+        msg = Message(id="c1", content="consume me", user_id="t")
+        mgr.push_message(msg)
+        deadline = time.time() + 15
+        while time.time() < deadline and not msg.response:
+            time.sleep(0.02)
+        assert msg.response
+    finally:
+        app.stop()
+
+
+def test_check_command_exit_code():
+    assert main(["--backend", "echo", "check"]) == 0
