@@ -286,6 +286,7 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
 
     # Decode: chunked program — sampling/EOS stay on device, one host
     # round-trip per `chunk` tokens (host sync latency amortized).
+    from llmq_tpu.utils.profiling import trace
     positions = np.full(batch, prompt_len, np.int32)
     tokens = toks[:, -1].copy()
     temps = np.zeros(batch, np.float32)
@@ -296,10 +297,11 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     tokens = out[:, -1]
     positions += chunk
     t0 = time.perf_counter()
-    for _ in range(n_calls):
-        out = ex.decode_chunk(tokens, positions, bt, temps, budgets)
-        tokens = out[:, -1]
-        positions += chunk
+    with trace("decode"):  # LLMQ_TRACE_DIR=… captures an xprof trace
+        for _ in range(n_calls):
+            out = ex.decode_chunk(tokens, positions, bt, temps, budgets)
+            tokens = out[:, -1]
+            positions += chunk
     dt = time.perf_counter() - t0
     n_tok = n_calls * chunk
     step_ms = dt / n_tok * 1e3

@@ -340,6 +340,11 @@ def load_config(path: Optional[str] = None, env: bool = True) -> Config:
     ``./configs/config.yaml`` (reference searches {configPath, ., ./configs}).
     """
     cfg = default_config()
+    if path is None:
+        # CONFIG_PATH analogue. An explicitly-requested path (flag OR
+        # env) that doesn't exist must fail fast, not silently serve
+        # defaults — `path` stays set so the loop's else-branch raises.
+        path = os.environ.get("LLMQ_CONFIG") or None
     candidates = [path] if path else ["config.yaml", os.path.join("configs", "config.yaml")]
     for cand in candidates:
         if cand and os.path.exists(cand):

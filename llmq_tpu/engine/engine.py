@@ -53,6 +53,7 @@ from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from llmq_tpu.metrics.registry import get_metrics
 from llmq_tpu.utils.logging import get_logger
+from llmq_tpu.utils.profiling import SpanRecorder
 
 log = get_logger("engine")
 
@@ -200,6 +201,8 @@ class InferenceEngine:
         self.kv_pin_ttl = kv_pin_ttl
         self._clock = clock or SYSTEM_CLOCK
         self._metrics = get_metrics() if enable_metrics else None
+        # Per-engine recorder: stats must not mix spans across engines.
+        self._prof = SpanRecorder()
 
         self.allocator = PageAllocator(self.spec.num_pages,
                                        self.spec.page_size)
@@ -598,8 +601,10 @@ class InferenceEngine:
                 seq.pages.extend(pages)
 
             was_rebuild = seq.rebuild
-            first = self.executor.prefill(ids, start_pos, seq.block_table,
-                                          req.temperature, slot)
+            with self._prof.span("engine.prefill", tokens=len(ids)):
+                first = self.executor.prefill(ids, start_pos,
+                                              seq.block_table,
+                                              req.temperature, slot)
             seq.pos = start_pos + len(ids)
             if was_rebuild or start_pos == 0:
                 seq.written_ids = list(ids)
@@ -705,12 +710,15 @@ class InferenceEngine:
             block_tables[i] = seq.block_table
             temps[i] = seq.req.temperature
             budgets[i] = budgets_by_order.get(seq.order, 1)
-        if chunk > 1 and hasattr(self.executor, "decode_chunk"):
-            out = self.executor.decode_chunk(tokens, positions, block_tables,
-                                             temps, budgets)
-        else:
-            out = self.executor.decode(tokens, positions, block_tables,
-                                       temps)[:, None]
+        with self._prof.span("engine.decode_chunk",
+                             active=len(active), chunk=chunk):
+            if chunk > 1 and hasattr(self.executor, "decode_chunk"):
+                out = self.executor.decode_chunk(tokens, positions,
+                                                 block_tables, temps,
+                                                 budgets)
+            else:
+                out = self.executor.decode(tokens, positions, block_tables,
+                                           temps)[:, None]
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
@@ -823,4 +831,5 @@ class InferenceEngine:
             "kv_pages_used": self.allocator.used(),
             "kv_pages_total": self.allocator.total,
             "cached_conversations": cached,
+            "profile": self._prof.summary(),
         }

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Protocol
 import numpy as np
 
 from llmq_tpu.utils.logging import get_logger
+from llmq_tpu.utils.profiling import annotate
 
 log = get_logger("executor")
 
@@ -314,14 +315,15 @@ class JaxExecutor:
             padded = np.zeros(T, np.int32)
             padded[: len(chunk)] = chunk
             positions = np.minimum(pos + np.arange(T), pos + len(chunk) - 1)
-            tok, self.cache = self._prefill_step(
-                self.params, self.cache,
-                jnp.asarray(padded)[None, :],
-                jnp.asarray(positions, jnp.int32)[None, :],
-                jnp.asarray([len(chunk)], jnp.int32),
-                bt,
-                jnp.asarray([temperature], jnp.float32),
-                self._next_key())
+            with annotate(f"prefill_b{T}"):  # named region in xprof traces
+                tok, self.cache = self._prefill_step(
+                    self.params, self.cache,
+                    jnp.asarray(padded)[None, :],
+                    jnp.asarray(positions, jnp.int32)[None, :],
+                    jnp.asarray([len(chunk)], jnp.int32),
+                    bt,
+                    jnp.asarray([temperature], jnp.float32),
+                    self._next_key())
             pos += len(chunk)
         if tok is None:
             return spec.eos_id
@@ -344,14 +346,15 @@ class JaxExecutor:
                      block_tables: np.ndarray, temperatures: np.ndarray,
                      budgets: np.ndarray) -> np.ndarray:
         jnp = self._jnp
-        toks, self.cache = self._decode_chunk(
-            self.params, self.cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32),
-            jnp.asarray(temperatures, jnp.float32),
-            jnp.asarray(budgets, jnp.int32),
-            self._next_key())
+        with annotate("decode_chunk"):
+            toks, self.cache = self._decode_chunk(
+                self.params, self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(budgets, jnp.int32),
+                self._next_key())
         return np.asarray(toks)
 
     def release_slot(self, slot: int) -> None:

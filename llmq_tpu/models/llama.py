@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from llmq_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
+from llmq_tpu.ops.attention import (blockwise_prefill_attention,
+                                    dispatch_paged_decode_attention)
 from llmq_tpu.ops.norms import rms_norm
 from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -140,6 +141,36 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 
 def param_count(params: Params) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def param_count_analytic(cfg: LlamaConfig) -> int:
+    """Parameter count from the config alone (no materialization — 70B
+    is 141 GB of bf16; sizing math must not allocate it)."""
+    D, H, HKV, F, V, L = (cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                          cfg.ffn_dim, cfg.vocab_size, cfg.n_layers)
+    hd = cfg.head_dim
+    per_layer = (D * H * hd          # wq
+                 + 2 * D * HKV * hd  # wk, wv
+                 + H * hd * D        # wo
+                 + 3 * D * F         # gate, up, down
+                 + 2 * D)            # attn_norm, mlp_norm
+    total = V * D + L * per_layer + D
+    if not cfg.tie_embeddings:
+        total += D * V
+    return total
+
+
+def weight_bytes(cfg: LlamaConfig) -> int:
+    """Weight footprint in bytes at the config dtype (bf16 = 2 B/param)."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return param_count_analytic(cfg) * itemsize
+
+
+def kv_bytes_per_token(cfg: LlamaConfig,
+                       cache_dtype: Optional[Any] = None) -> int:
+    """HBM cost of one cached token across all layers (K and V)."""
+    itemsize = jnp.dtype(cache_dtype or cfg.dtype).itemsize
+    return 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * itemsize
 
 
 def init_kv_pages(cfg: LlamaConfig, num_pages: int, page_size: int,
@@ -256,23 +287,14 @@ def _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens):
     by construction slot index IS the absolute position (block_tables map
     position//page_size → page), so the mask is kv_pos <= q_pos and
     kv_pos < seq_len.
+
+    Delegates to the blockwise online-softmax implementation: peak
+    activation memory stays O(T·block) regardless of the padded window
+    width S, so 8k-context prefill never materializes (B, H, T, S) f32
+    logits (GBs per layer at scale).
     """
-    B, T, H, D = q.shape
-    S = k_hist.shape[1]
-    Hkv = k_hist.shape[2]
-    n_rep = H // Hkv
-    # Grouped-query einsum: no n_rep-fold K/V repeat, bf16 on the MXU
-    # with f32 accumulation (see ops/attention.py rationale).
-    qg = q.reshape(B, T, Hkv, n_rep, D)
-    logits = jnp.einsum("btgrd,bsgd->bgrts", qg, k_hist,
-                        preferred_element_type=jnp.float32) * (D ** -0.5)
-    kv_pos = jnp.arange(S)[None, None, :]                  # (1,1,S)
-    mask = (kv_pos <= positions[:, :, None]) & (kv_pos < seq_lens[:, None, None])
-    logits = jnp.where(mask[:, None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bgrts,bsgd->btgrd", probs.astype(v_hist.dtype), v_hist,
-                     preferred_element_type=jnp.float32)
-    return out.reshape(B, T, H, D).astype(q.dtype)
+    return blockwise_prefill_attention(q, k_hist, v_hist, positions,
+                                       seq_lens)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -316,8 +338,8 @@ def forward_decode(
         v = v[:, 0]
         k_pages = k_pages.at[page_of, slot_of].set(k)
         v_pages = v_pages.at[page_of, slot_of].set(v)
-        attn = paged_decode_attention(q, k_pages, v_pages, block_tables,
-                                      seq_lens)            # (B, H, D)
+        attn = dispatch_paged_decode_attention(
+            q, k_pages, v_pages, block_tables, seq_lens)   # (B, H, D)
         h = h + jnp.dot(attn.reshape(B, -1), wo)
         hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
         h = h + _mlp(hn2, w_gate, w_up, w_down)

@@ -1,4 +1,4 @@
-"""Attention ops: causal prefill + paged decode (pure-JAX reference).
+"""Attention ops: causal prefill + paged decode.
 
 The paged layout (BASELINE north star; PAPERS.md ragged paged attention)
 stores KV in fixed-size pages indexed by per-sequence block tables, so
@@ -6,12 +6,24 @@ conversations of different lengths share one HBM pool with no per-request
 reallocation and no recompilation (static shapes throughout — XLA traces
 once per batch geometry bucket).
 
-The Pallas TPU kernel for the decode hot path lives in
-``ops/pallas/paged_attention.py``; this module is the semantics
-reference it is tested against, and the fallback on non-TPU backends.
+Two implementations of the decode hot path:
+
+- :func:`paged_decode_attention` (this module) — pure JAX, the semantics
+  reference and the fallback on non-TPU backends. Gathers the full
+  padded window per step (correct, bandwidth-naive).
+- ``ops/pallas/paged_attention.py`` — the Pallas TPU kernel: streams
+  only live pages HBM→VMEM with double-buffered DMA and an online
+  softmax; tested against this module in tests/test_pallas.py.
+
+:func:`dispatch_paged_decode_attention` picks between them (TPU →
+kernel, else pure JAX; ``LLMQ_PALLAS=0`` forces the fallback).
+:func:`blockwise_prefill_attention` is the memory-bounded prefill
+(online softmax over KV chunks — no (B, H, T, S) f32 logits tensor).
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -85,3 +97,86 @@ def paged_decode_attention(
     out = jnp.einsum("bgrs,bsgd->bgrd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, H, D).astype(q.dtype)
+
+
+def dispatch_paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                    seq_lens) -> jnp.ndarray:
+    """Route the decode hot path: Pallas kernel on TPU, pure JAX
+    elsewhere. ``LLMQ_PALLAS=0`` forces pure JAX (e.g. to A/B the
+    kernel on hardware); ``LLMQ_PALLAS=interpret`` runs the kernel in
+    interpret mode (CI coverage of the kernel body without a TPU)."""
+    mode = os.environ.get("LLMQ_PALLAS", "auto")
+    kernel_ok = (k_pages.shape[2] * k_pages.shape[3]) % 128 == 0
+    if mode != "0" and kernel_ok:
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu or mode == "interpret":
+            from llmq_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas)
+            return paged_decode_attention_pallas(
+                q, k_pages, v_pages, block_tables, seq_lens,
+                interpret=not on_tpu)
+    return paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                  seq_lens)
+
+
+def blockwise_prefill_attention(
+    q: jnp.ndarray,          # (B, T, H, D)
+    k_hist: jnp.ndarray,     # (B, S, H_kv, D)
+    v_hist: jnp.ndarray,     # (B, S, H_kv, D)
+    positions: jnp.ndarray,  # (B, T) absolute position of each query
+    seq_lens: jnp.ndarray,   # (B,) visible history length
+    *,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """Prefill attention with online softmax over KV chunks.
+
+    Same semantics as the full-logits version (mask: kv_pos <= q_pos and
+    kv_pos < seq_len) but peak memory is O(B·H·T·block_size) f32 instead
+    of O(B·H·T·S) — the difference between GBs-per-layer and MBs at 8k
+    context (VERDICT r1 weak #4). ``lax.scan`` over chunks keeps one
+    compiled body; XLA fuses mask+softmax into the chunk matmuls.
+    """
+    B, T, H, D = q.shape
+    S = k_hist.shape[1]
+    Hkv = k_hist.shape[2]
+    n_rep = H // Hkv
+    Sb = min(block_size, S)
+    while S % Sb:
+        Sb -= 1
+    n_blocks = S // Sb
+    scale = D ** -0.5
+    qg = q.reshape(B, T, Hkv, n_rep, D)
+
+    # (n_blocks, B, Sb, ...) leading-axis chunks for scan.
+    k_c = jnp.moveaxis(k_hist.reshape(B, n_blocks, Sb, Hkv, D), 1, 0)
+    v_c = jnp.moveaxis(v_hist.reshape(B, n_blocks, Sb, Hkv, D), 1, 0)
+
+    def body(carry, xs):
+        m_prev, l_prev, acc = carry                         # (B,T,g,r,·)
+        i, k_b, v_b = xs
+        logits = jnp.einsum("btgrd,bsgd->btgrs", qg, k_b,
+                            preferred_element_type=jnp.float32) * scale
+        kv_pos = i * Sb + jnp.arange(Sb)[None, :]           # (1, Sb)
+        mask = ((kv_pos[:, None, :] <= positions[:, :, None])
+                & (kv_pos[:, None, :] < seq_lens[:, None, None]))  # (B,T,Sb)
+        mask = mask[:, :, None, None, :]                    # (B,T,1,1,Sb)
+        logits = jnp.where(mask, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # Explicit zero for masked entries: a fully-masked chunk keeps
+        # m_new at NEG_INF and exp(logits - m_new) would be exp(0)=1.
+        p = jnp.where(mask, jnp.exp(logits - m_new), 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "btgrs,bsgd->btgrd", p.astype(v_b.dtype), v_b,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc), None
+
+    m0 = jnp.full((B, T, Hkv, n_rep, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, T, Hkv, n_rep, 1), jnp.float32)
+    acc0 = jnp.zeros((B, T, Hkv, n_rep, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), (jnp.arange(n_blocks), k_c, v_c))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, T, H, D).astype(q.dtype)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the serving hot paths."""
+
+from llmq_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
+
+__all__ = ["paged_decode_attention_pallas"]

@@ -1,0 +1,150 @@
+"""Tracing / profiling hooks (SURVEY.md §5: the reference documents
+pprof/Jaeger wiring but implements none of it; here tracing is real
+code).
+
+Two layers:
+
+- **Device tracing** — :func:`trace` wraps a code region in
+  ``jax.profiler`` (xprof): one trace captures XLA program timings, HBM
+  transfers and TPU utilization, viewable in XProf/perfetto/tensorboard.
+  Enabled ambiently by setting ``LLMQ_TRACE_DIR`` (bench.py and the
+  engine loop honor it).
+- **Host spans** — :class:`SpanRecorder`, a lightweight in-process
+  span log (name, start, duration) for control-plane paths (queue pop →
+  admission → decode chunk), exposed via ``GET /api/v1/engine/stats``
+  and dumpable to Chrome trace-event JSON for chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("profiling")
+
+TRACE_DIR_ENV = "LLMQ_TRACE_DIR"
+
+
+def trace_dir() -> Optional[str]:
+    return os.environ.get(TRACE_DIR_ENV) or None
+
+
+@contextmanager
+def trace(label: str = "llmq") -> Iterator[None]:
+    """Capture a jax.profiler trace of the region if LLMQ_TRACE_DIR is
+    set; no-op otherwise. Safe on any backend."""
+    d = trace_dir()
+    if not d:
+        yield
+        return
+    import jax
+
+    out = os.path.join(d, label)
+    os.makedirs(out, exist_ok=True)
+    log.info("tracing %s → %s", label, out)
+    with jax.profiler.trace(out):
+        yield
+    log.info("trace written to %s (view with xprof/tensorboard)", out)
+
+
+@contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-region inside a device trace (TraceAnnotation)."""
+    try:
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:  # noqa: BLE001 — annotation is best-effort
+        yield
+
+
+@dataclass
+class Span:
+    name: str
+    start: float      # perf_counter seconds
+    duration: float
+    meta: Optional[Dict] = None
+
+
+class SpanRecorder:
+    """Bounded in-memory span ring for control-plane profiling."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = capacity
+        self._spans: deque = deque(maxlen=capacity)  # O(1) bounded append
+        self._mu = threading.Lock()
+
+    @contextmanager
+    def span(self, name: str, **meta) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.perf_counter() - t0,
+                        meta or None)
+
+    def record(self, name: str, start: float, duration: float,
+               meta: Optional[Dict] = None) -> None:
+        with self._mu:
+            self._spans.append(Span(name, start, duration, meta))
+
+    def snapshot(self) -> List[Span]:
+        with self._mu:
+            return list(self._spans)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-name count/total/mean/max in milliseconds."""
+        out: Dict[str, Dict[str, float]] = {}
+        for s in self.snapshot():
+            d = out.setdefault(s.name, {"count": 0, "total_ms": 0.0,
+                                        "max_ms": 0.0})
+            d["count"] += 1
+            d["total_ms"] += s.duration * 1e3
+            d["max_ms"] = max(d["max_ms"], s.duration * 1e3)
+        for d in out.values():
+            d["mean_ms"] = d["total_ms"] / max(1, d["count"])
+            d["total_ms"] = round(d["total_ms"], 3)
+            d["mean_ms"] = round(d["mean_ms"], 3)
+            d["max_ms"] = round(d["max_ms"], 3)
+        return out
+
+    def dump_chrome_trace(self, path: str) -> None:
+        """Write chrome://tracing / perfetto-compatible trace events."""
+        events = [
+            {"name": s.name, "ph": "X", "ts": s.start * 1e6,
+             "dur": s.duration * 1e6, "pid": 0, "tid": 0,
+             "args": s.meta or {}}
+            for s in self.snapshot()
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        log.info("wrote %d spans to %s", len(events), path)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._spans)
+
+
+_global_recorder: Optional[SpanRecorder] = None
+_global_mu = threading.Lock()
+
+
+def get_recorder() -> SpanRecorder:
+    global _global_recorder
+    with _global_mu:
+        if _global_recorder is None:
+            _global_recorder = SpanRecorder()
+        return _global_recorder

@@ -1,0 +1,158 @@
+"""Pallas paged-attention kernel vs the pure-JAX semantics reference.
+
+The kernel (ops/pallas/paged_attention.py) runs in interpret mode here —
+CPU CI covers the kernel body (DMA schedule, online softmax, masking)
+without TPU hardware; on-device numerics are exercised by bench.py on
+the real chip.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from llmq_tpu.ops.attention import (  # noqa: E402
+    blockwise_prefill_attention,
+    causal_prefill_attention,
+    paged_decode_attention,
+)
+from llmq_tpu.ops.pallas.paged_attention import (  # noqa: E402
+    paged_decode_attention_pallas)
+
+
+def _paged_setup(rng, *, B=4, H=8, Hkv=2, D=64, ps=16, P=32, mp=6,
+                 dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), dtype)
+    # Distinct non-zero pages per sequence (page 0 reserved).
+    ids = rng.permutation(np.arange(1, P))[: B * mp].reshape(B, mp)
+    bt = jnp.asarray(ids, jnp.int32)
+    return q, k, v, bt
+
+
+class TestPagedDecodeKernel:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(0)
+        q, k, v, bt = _paged_setup(rng)
+        # Lengths hit: single token, mid-page, page boundary, full window.
+        sl = jnp.asarray([1, 17, 32, 96], jnp.int32)
+        ref = paged_decode_attention(q, k, v, bt, sl)
+        out = paged_decode_attention_pallas(q, k, v, bt, sl,
+                                            pages_per_chunk=2,
+                                            interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_bf16_cache(self):
+        rng = np.random.default_rng(1)
+        q, k, v, bt = _paged_setup(rng, dtype=jnp.bfloat16)
+        sl = jnp.asarray([5, 40, 96, 64], jnp.int32)
+        ref = paged_decode_attention(q, k, v, bt, sl).astype(jnp.float32)
+        out = paged_decode_attention_pallas(
+            q, k, v, bt, sl, pages_per_chunk=4,
+            interpret=True).astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-2, rtol=5e-2)
+
+    def test_chunk_width_irrelevant(self):
+        rng = np.random.default_rng(2)
+        q, k, v, bt = _paged_setup(rng)
+        sl = jnp.asarray([9, 25, 50, 80], jnp.int32)
+        a = paged_decode_attention_pallas(q, k, v, bt, sl,
+                                          pages_per_chunk=1, interpret=True)
+        b = paged_decode_attention_pallas(q, k, v, bt, sl,
+                                          pages_per_chunk=3, interpret=True)
+        c = paged_decode_attention_pallas(q, k, v, bt, sl,
+                                          pages_per_chunk=6, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-2, rtol=2e-2)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_dead_pages_never_read(self):
+        """Garbage (NaN) in pages beyond seq_len must not leak: dead
+        pages are skipped by the DMA schedule and masked in compute."""
+        rng = np.random.default_rng(3)
+        q, k, v, bt = _paged_setup(rng)
+        sl = jnp.asarray([1, 16, 33, 90], jnp.int32)
+        k_np, v_np = np.asarray(k).copy(), np.asarray(v).copy()
+        ps = k_np.shape[1]
+        mp = bt.shape[1]
+        for b in range(bt.shape[0]):
+            n_live = -(-int(sl[b]) // ps)
+            for dead in np.asarray(bt)[b, n_live:mp]:
+                k_np[dead] = np.nan
+                v_np[dead] = np.nan
+        out = paged_decode_attention_pallas(
+            jnp.asarray(q), jnp.asarray(k_np), jnp.asarray(v_np), bt, sl,
+            pages_per_chunk=2, interpret=True)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_model_dispatch_under_interpret(self, monkeypatch):
+        """forward_decode routes through the kernel when
+        LLMQ_PALLAS=interpret and produces the same logits as pure JAX."""
+        monkeypatch.setenv("LLMQ_PALLAS", "0")
+        from llmq_tpu.models.llama import (forward_decode, get_config,
+                                           init_kv_pages, init_params)
+        # H_kv·head_dim must be 128-aligned for the kernel path: 2·64.
+        cfg = get_config("llama3-tiny", max_seq_len=64, dim=256,
+                         n_heads=4, n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        cache = init_kv_pages(cfg, 16, 8)
+        bt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        toks = jnp.asarray([7], jnp.int32)
+        pos = jnp.asarray([3], jnp.int32)
+        ref, _ = forward_decode(params, cfg, toks, pos, cache, bt)
+        monkeypatch.setenv("LLMQ_PALLAS", "interpret")
+        # The env var is read at trace time; equal configs share a jit
+        # cache entry, so force a retrace to route through the kernel.
+        jax.clear_caches()
+        out, _ = forward_decode(params, cfg, toks, pos, cache, bt)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-2, rtol=3e-2)
+        jax.clear_caches()  # don't leak interpret-mode traces to others
+
+
+class TestBlockwisePrefill:
+    def test_matches_full_softmax(self):
+        rng = np.random.default_rng(4)
+        B, T, S, H, Hkv, D = 2, 8, 48, 8, 2, 32
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+        positions = jnp.asarray(
+            np.stack([np.arange(T), np.arange(10, 10 + T)]), jnp.int32)
+        seq_lens = jnp.asarray([T, 10 + T], jnp.int32)
+
+        # Full-softmax reference with the same mask.
+        qg = q.reshape(B, T, Hkv, H // Hkv, D)
+        logits = jnp.einsum("btgrd,bsgd->bgrts", qg, k) * (D ** -0.5)
+        kv_pos = jnp.arange(S)[None, None, :]
+        mask = ((kv_pos <= positions[:, :, None])
+                & (kv_pos < seq_lens[:, None, None]))
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ref = jnp.einsum("bgrts,bsgd->btgrd", probs, v).reshape(B, T, H, D)
+
+        for bs in (8, 16, 48, 512):
+            out = blockwise_prefill_attention(q, k, v, positions, seq_lens,
+                                              block_size=bs)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-2, rtol=2e-2)
+
+    def test_matches_causal_prefill(self):
+        """Zero-offset case must agree with causal_prefill_attention."""
+        rng = np.random.default_rng(5)
+        B, T, H, Hkv, D = 2, 16, 4, 2, 32
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+        seq_lens = jnp.full((B,), T, jnp.int32)
+        ref = causal_prefill_attention(q, k, v)
+        out = blockwise_prefill_attention(q, k, v, positions, seq_lens,
+                                          block_size=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)

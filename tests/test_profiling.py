@@ -1,0 +1,82 @@
+"""Tracing/profiling subsystem (SURVEY.md §5 — real code here, unlike
+the reference's docs-only pprof/Jaeger recipes)."""
+
+import json
+import os
+
+from llmq_tpu.utils.profiling import SpanRecorder, get_recorder, trace
+
+
+class TestSpanRecorder:
+    def test_span_and_summary(self):
+        rec = SpanRecorder()
+        with rec.span("queue.pop"):
+            pass
+        with rec.span("queue.pop"):
+            pass
+        with rec.span("engine.decode_chunk", active=3):
+            pass
+        s = rec.summary()
+        assert s["queue.pop"]["count"] == 2
+        assert s["engine.decode_chunk"]["count"] == 1
+        assert s["engine.decode_chunk"]["mean_ms"] >= 0
+
+    def test_capacity_bound(self):
+        rec = SpanRecorder(capacity=10)
+        for i in range(50):
+            rec.record(f"s{i}", 0.0, 0.001)
+        assert len(rec.snapshot()) == 10
+        assert rec.snapshot()[-1].name == "s49"
+
+    def test_chrome_trace_dump(self, tmp_path):
+        rec = SpanRecorder()
+        with rec.span("a", foo=1):
+            pass
+        p = tmp_path / "trace.json"
+        rec.dump_chrome_trace(str(p))
+        data = json.loads(p.read_text())
+        assert data["traceEvents"][0]["name"] == "a"
+        assert data["traceEvents"][0]["args"] == {"foo": 1}
+
+    def test_clear(self):
+        rec = SpanRecorder()
+        with rec.span("x"):
+            pass
+        rec.clear()
+        assert rec.snapshot() == []
+
+    def test_global_recorder_singleton(self):
+        assert get_recorder() is get_recorder()
+
+
+class TestDeviceTrace:
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv("LLMQ_TRACE_DIR", raising=False)
+        with trace("unit"):
+            x = 1 + 1
+        assert x == 2
+
+    def test_writes_trace_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LLMQ_TRACE_DIR", str(tmp_path))
+        import jax
+        import jax.numpy as jnp
+        with trace("unit"):
+            jnp.zeros(8).block_until_ready()
+        out = tmp_path / "unit"
+        assert out.exists()
+        # jax.profiler writes a plugins/profile tree with trace files.
+        found = [f for _, _, fs in os.walk(out) for f in fs]
+        assert found, "profiler produced no files"
+
+    def test_engine_stats_include_profile(self):
+        from llmq_tpu.engine import EchoExecutor, InferenceEngine
+        from llmq_tpu.engine.tokenizer import ByteTokenizer
+        tok = ByteTokenizer()
+        ex = EchoExecutor(batch_size=2, eos_id=tok.eos_id)
+        eng = InferenceEngine(ex, tok, enable_metrics=False)
+        from llmq_tpu.engine.engine import GenRequest
+        h = eng.submit(GenRequest(id="r1", prompt="hi", max_new_tokens=4))
+        eng.run_until_idle()
+        assert h.done
+        stats = eng.get_stats()
+        assert "engine.prefill" in stats["profile"]
