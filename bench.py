@@ -296,13 +296,15 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     out = ex.decode_chunk(tokens, positions, bt, temps, budgets)  # warm
     tokens = out[:, -1]
     positions += chunk
-    t0 = time.perf_counter()
     with trace("decode"):  # LLMQ_TRACE_DIR=… captures an xprof trace
+        # Timing window excludes profiler session start/stop and
+        # trace-file writes (they can cost seconds when tracing is on).
+        t0 = time.perf_counter()
         for _ in range(n_calls):
             out = ex.decode_chunk(tokens, positions, bt, temps, budgets)
             tokens = out[:, -1]
             positions += chunk
-    dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0
     n_tok = n_calls * chunk
     step_ms = dt / n_tok * 1e3
     tps = batch * n_tok / dt

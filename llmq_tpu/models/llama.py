@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from llmq_tpu.ops.attention import (blockwise_prefill_attention,
-                                    dispatch_paged_decode_attention)
+                                    dispatch_paged_decode_attention,
+                                    paged_kv_write)
 from llmq_tpu.ops.norms import rms_norm
 from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -191,16 +192,6 @@ def _mlp(h: jnp.ndarray, w_gate, w_up, w_down) -> jnp.ndarray:
     return jnp.dot(jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u, w_down)
 
 
-def _paged_write(pages: jnp.ndarray, values: jnp.ndarray,
-                 page_ids: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
-    """Scatter flat token KVs into the page pool.
-
-    pages: (P, page_size, H_kv, D); values: (N, H_kv, D);
-    page_ids/slots: (N,).
-    """
-    return pages.at[page_ids, slots].set(values)
-
-
 @partial(jax.jit, static_argnames=("cfg",))
 def forward_prefill(
     params: Params,
@@ -243,6 +234,13 @@ def forward_prefill(
     last_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)
     seq_lens = last_pos + 1                                # (B,)
 
+    # Pool flows through the scan as per-layer xs/ys slices. The ys
+    # re-stacking rewrites the pool once per call — amortized over a
+    # whole prefill chunk that is noise, and unlike a carried pool it
+    # never degenerates into per-layer full-pool copies (XLA treats a
+    # carried pool consumed by both a scatter and a gather very
+    # conservatively; measured 4-10x slower). The latency-critical
+    # decode path (forward_decode) is unrolled instead.
     def layer(h, xs):
         (wq, wk, wv, wo, w_gate, w_up, w_down, attn_norm, mlp_norm,
          k_pages, v_pages) = xs
@@ -253,14 +251,16 @@ def forward_prefill(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # Write this layer's KV into its page pool.
-        k_pages = _paged_write(k_pages, k.reshape(-1, cfg.n_kv_heads, cfg.head_dim),
-                               page_of, slot_of)
-        v_pages = _paged_write(v_pages, v.reshape(-1, cfg.n_kv_heads, cfg.head_dim),
-                               page_of, slot_of)
+        k_pages = k_pages.at[page_of, slot_of].set(
+            k.reshape(-1, cfg.n_kv_heads, cfg.head_dim))
+        v_pages = v_pages.at[page_of, slot_of].set(
+            v.reshape(-1, cfg.n_kv_heads, cfg.head_dim))
         # Attend over the full paged history (covers continuation turns);
         # causality enforced via absolute positions.
-        k_hist = k_pages[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-        v_hist = v_pages[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        k_hist = k_pages[block_tables].reshape(
+            B, S, cfg.n_kv_heads, cfg.head_dim)
+        v_hist = v_pages[block_tables].reshape(
+            B, S, cfg.n_kv_heads, cfg.head_dim)
         attn = _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens)
         h = h + jnp.dot(attn.reshape(B, T, -1), wo)
         hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
@@ -326,30 +326,38 @@ def forward_decode(
     slot_of = positions % page_sz
     seq_lens = positions + 1
 
-    def layer(h, xs):
-        (wq, wk, wv, wo, w_gate, w_up, w_down, attn_norm, mlp_norm,
-         k_pages, v_pages) = xs
-        hn = rms_norm(h, attn_norm, cfg.norm_eps)
-        q = jnp.dot(hn, wq).reshape(B, 1, cfg.n_heads, cfg.head_dim)
-        k = jnp.dot(hn, wk).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.dot(hn, wv).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+    # Layers are UNROLLED (no scan) and the stacked pool threads through
+    # one aliased Pallas write + one attention read per layer. This is
+    # what makes the decode step in-place: the write kernel aliases its
+    # pool operand (input_output_aliases), so 16 sequential calls update
+    # one buffer. Any scan formulation forces XLA to materialize pool
+    # copies (ys stacking rewrites it once per call; a carried pool
+    # degrades to per-layer full copies) — measured 2-8x slower on v5e.
+    # Unrolling costs compile time (once, at warmup) instead.
+    lp = params["layers"]
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    for l in range(cfg.n_layers):
+        hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
+        q = jnp.dot(hn, lp["wq"][l]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        k = jnp.dot(hn, lp["wk"][l]).reshape(B, 1, cfg.n_kv_heads,
+                                             cfg.head_dim)
+        v = jnp.dot(hn, lp["wv"][l]).reshape(B, 1, cfg.n_kv_heads,
+                                             cfg.head_dim)
         q = apply_rope(q, cos, sin)[:, 0]                  # (B, H, D)
         k = apply_rope(k, cos, sin)[:, 0]                  # (B, H_kv, D)
         v = v[:, 0]
-        k_pages = k_pages.at[page_of, slot_of].set(k)
-        v_pages = v_pages.at[page_of, slot_of].set(v)
+        # distinct_pages: every live sequence owns its page this step
+        # (inactive rows share reserved page 0, never read).
+        k_pool, v_pool = paged_kv_write(k_pool, v_pool, k, v,
+                                        page_of, slot_of, l,
+                                        distinct_pages=True)
         attn = dispatch_paged_decode_attention(
-            q, k_pages, v_pages, block_tables, seq_lens)   # (B, H, D)
-        h = h + jnp.dot(attn.reshape(B, -1), wo)
-        hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
-        h = h + _mlp(hn2, w_gate, w_up, w_down)
-        return h, (k_pages, v_pages)
-
-    lp = params["layers"]
-    xs = (lp["wq"], lp["wk"], lp["wv"], lp["wo"], lp["w_gate"], lp["w_up"],
-          lp["w_down"], lp["attn_norm"], lp["mlp_norm"],
-          kv_cache["k"], kv_cache["v"])
-    h, (new_k, new_v) = lax.scan(layer, h, xs)
+            q, k_pool, v_pool, block_tables, seq_lens,
+            jnp.int32(l))                                  # (B, H, D)
+        h = h + jnp.dot(attn.reshape(B, -1), lp["wo"][l])
+        hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
+        h = h + _mlp(hn2, lp["w_gate"][l], lp["w_up"][l], lp["w_down"][l])
+    new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params.get("lm_head")
     logits = (jnp.dot(h, head) if head is not None

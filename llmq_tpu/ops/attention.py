@@ -65,28 +65,15 @@ def causal_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, T, H, D).astype(q.dtype)
 
 
-def paged_decode_attention(
-    q: jnp.ndarray,            # (B, H, D) — one new token per sequence
-    k_pages: jnp.ndarray,      # (P, page_size, H_kv, D) global page pool
-    v_pages: jnp.ndarray,      # (P, page_size, H_kv, D)
-    block_tables: jnp.ndarray,  # (B, max_pages) int32 page ids (pad = any valid id)
-    seq_lens: jnp.ndarray,     # (B,) int32 — tokens already in cache incl. current
-) -> jnp.ndarray:
-    """Single-token decode attention over the paged KV pool.
-
-    Gathers each sequence's pages via its block table, masks beyond
-    ``seq_lens`` and runs GQA attention (grouped einsum, no K/V repeat —
-    see :func:`causal_prefill_attention`). Returns (B, H, D).
-    """
+def _gqa_attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                seq_lens: jnp.ndarray) -> jnp.ndarray:
+    """Shared decode-attention math: q (B, H, D) against gathered
+    history k/v (B, S, H_kv, D), masked beyond ``seq_lens``. GQA via
+    grouped einsum (no K/V repeat). Returns (B, H, D)."""
     B, H, D = q.shape
-    page_size = k_pages.shape[1]
-    max_pages = block_tables.shape[1]
-    S = max_pages * page_size
-    Hkv = k_pages.shape[2]
+    S = k.shape[1]
+    Hkv = k.shape[2]
     n_rep = H // Hkv
-    # Gather: (B, max_pages, page_size, H_kv, D) → (B, S, H_kv, D)
-    k = k_pages[block_tables].reshape(B, S, Hkv, D)
-    v = v_pages[block_tables].reshape(B, S, Hkv, D)
     scale = D ** -0.5
     qg = q.reshape(B, Hkv, n_rep, D)
     logits = jnp.einsum("bgrd,bsgd->bgrs", qg, k,
@@ -99,24 +86,114 @@ def paged_decode_attention(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
-def dispatch_paged_decode_attention(q, k_pages, v_pages, block_tables,
-                                    seq_lens) -> jnp.ndarray:
-    """Route the decode hot path: Pallas kernel on TPU, pure JAX
-    elsewhere. ``LLMQ_PALLAS=0`` forces pure JAX (e.g. to A/B the
-    kernel on hardware); ``LLMQ_PALLAS=interpret`` runs the kernel in
-    interpret mode (CI coverage of the kernel body without a TPU)."""
+def paged_decode_attention(
+    q: jnp.ndarray,            # (B, H, D) — one new token per sequence
+    k_pages: jnp.ndarray,      # (P, page_size, H_kv, D) global page pool
+    v_pages: jnp.ndarray,      # (P, page_size, H_kv, D)
+    block_tables: jnp.ndarray,  # (B, max_pages) int32 page ids (pad = any valid id)
+    seq_lens: jnp.ndarray,     # (B,) int32 — tokens already in cache incl. current
+) -> jnp.ndarray:
+    """Single-token decode attention over a single-layer paged KV pool
+    (the semantics reference the Pallas kernel is tested against).
+
+    Gathers each sequence's pages via its block table, masks beyond
+    ``seq_lens`` and runs GQA attention. Returns (B, H, D).
+    """
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    S = block_tables.shape[1] * page_size
+    Hkv = k_pages.shape[2]
+    # Gather: (B, max_pages, page_size, H_kv, D) → (B, S, H_kv, D)
+    k = k_pages[block_tables].reshape(B, S, Hkv, D)
+    v = v_pages[block_tables].reshape(B, S, Hkv, D)
+    return _gqa_attend(q, k, v, seq_lens)
+
+
+def paged_decode_attention_pooled(
+    q: jnp.ndarray,            # (B, H, D)
+    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D) all-layer pool
+    v_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D)
+    block_tables: jnp.ndarray,  # (B, max_pages) int32
+    seq_lens: jnp.ndarray,     # (B,) int32
+    layer: jnp.ndarray,        # scalar int32 — which layer's pages to read
+) -> jnp.ndarray:
+    """Decode attention reading layer ``layer`` of the stacked pool.
+
+    The pool keeps its layer dimension so forward_decode's unrolled
+    layer loop threads one pool buffer through every layer (scan
+    formulations force XLA to materialize pool copies — see the
+    comment in llama.py:forward_decode). The combined gather
+    ``k_pool[layer, block_tables]`` stays a single XLA gather.
+    """
+    B, H, D = q.shape
+    page_size = k_pool.shape[2]
+    S = block_tables.shape[1] * page_size
+    Hkv = k_pool.shape[3]
+    k = k_pool[layer, block_tables].reshape(B, S, Hkv, D)
+    v = v_pool[layer, block_tables].reshape(B, S, Hkv, D)
+    return _gqa_attend(q, k, v, seq_lens)
+
+
+def _kernel_route(k_pool, *, extra_ok: bool = True):
+    """Shared LLMQ_PALLAS routing policy for the paged-KV kernels.
+
+    Returns (use_kernel, interpret). Kernel eligibility: not disabled
+    (``LLMQ_PALLAS=0``), ``extra_ok``, H_kv·D lane-aligned, and either a
+    TPU backend or ``LLMQ_PALLAS=interpret`` (CI coverage of kernel
+    bodies without a TPU)."""
     mode = os.environ.get("LLMQ_PALLAS", "auto")
-    kernel_ok = (k_pages.shape[2] * k_pages.shape[3]) % 128 == 0
-    if mode != "0" and kernel_ok:
-        on_tpu = jax.default_backend() == "tpu"
-        if on_tpu or mode == "interpret":
-            from llmq_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention_pallas)
-            return paged_decode_attention_pallas(
-                q, k_pages, v_pages, block_tables, seq_lens,
-                interpret=not on_tpu)
-    return paged_decode_attention(q, k_pages, v_pages, block_tables,
-                                  seq_lens)
+    aligned = (k_pool.shape[3] * k_pool.shape[4]) % 128 == 0
+    if mode == "0" or not extra_ok or not aligned:
+        return False, False
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        return True, False
+    if mode == "interpret":
+        return True, True
+    return False, False
+
+
+def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
+                   *, distinct_pages: bool = False):
+    """Write N token rows into layer ``layer`` of the stacked pool.
+
+    TPU + ``distinct_pages=True`` (decode: every live row targets its
+    own page): Pallas page-RMW kernel with input/output aliasing — XLA
+    scatter costs ~13µs/row on TPU regardless of row size and would
+    dominate the whole decode step. Elsewhere (and for prefill, whose
+    rows share pages): the .at[] scatter.
+    Pools (L, P, page_size, H_kv, D); k_new/v_new (N, H_kv, D).
+    """
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=distinct_pages)
+    if use_kernel:
+        from llmq_tpu.ops.pallas.kv_write import kv_cache_write_pallas
+        return kv_cache_write_pallas(k_pool, v_pool, k_new, v_new,
+                                     page_of, slot_of, layer,
+                                     interpret=interpret)
+    k_pool = k_pool.at[layer, page_of, slot_of].set(k_new)
+    v_pool = v_pool.at[layer, page_of, slot_of].set(v_new)
+    return k_pool, v_pool
+
+
+def dispatch_paged_decode_attention(q, k_pool, v_pool, block_tables,
+                                    seq_lens, layer) -> jnp.ndarray:
+    """Route the decode hot path: Pallas kernel on TPU, pure JAX
+    elsewhere. Pools are stacked-layer (L, P, page_size, H_kv, D);
+    ``layer`` selects the layer inside the op, so forward_decode's
+    unrolled layer loop threads ONE pool buffer through all layers'
+    aliased writes and reads (llama.py explains why the loop is
+    unrolled rather than scanned). ``LLMQ_PALLAS=0`` forces pure JAX
+    (e.g. to A/B the kernel on hardware); ``LLMQ_PALLAS=interpret``
+    runs the kernel in interpret mode (CI without a TPU)."""
+    use_kernel, interpret = _kernel_route(k_pool)
+    if use_kernel:
+        from llmq_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+        return paged_decode_attention_pallas(
+            q, k_pool, v_pool, block_tables, seq_lens, layer,
+            interpret=interpret)
+    return paged_decode_attention_pooled(q, k_pool, v_pool, block_tables,
+                                         seq_lens, layer)
 
 
 def blockwise_prefill_attention(

@@ -69,10 +69,11 @@ def _decode_kernel(
     # scalar prefetch (SMEM)
     block_tables_ref,   # (B, max_pages) int32
     seq_lens_ref,       # (B,) int32
+    layer_ref,          # (1,) int32 — which pool layer to read
     # inputs
     q_ref,              # (1, H, GD) VMEM — block-diagonal per head group
-    k_hbm,              # (P, page_size, GD) in HBM/ANY
-    v_hbm,              # (P, page_size, GD) in HBM/ANY
+    k_hbm,              # (L, P, page_size, GD) in HBM/ANY
+    v_hbm,              # (L, P, page_size, GD) in HBM/ANY
     # outputs
     out_ref,            # (1, H, GD) VMEM
     # scratch
@@ -92,6 +93,7 @@ def _decode_kernel(
     c = pl.program_id(1)
     ppc = pages_per_chunk
     seq_len = seq_lens_ref[b]
+    lyr = layer_ref[0]
 
     def start_chunk(chunk, slot):
         """Kick off async copies of every live page of ``chunk``. Dead
@@ -110,11 +112,11 @@ def _decode_kernel(
             def _():
                 pid = block_tables_ref[b, base + j]
                 pltpu.make_async_copy(
-                    k_hbm.at[pid], k_scratch.at[slot, j], sem.at[0, slot, j]
-                ).start()
+                    k_hbm.at[lyr, pid], k_scratch.at[slot, j],
+                    sem.at[0, slot, j]).start()
                 pltpu.make_async_copy(
-                    v_hbm.at[pid], v_scratch.at[slot, j], sem.at[1, slot, j]
-                ).start()
+                    v_hbm.at[lyr, pid], v_scratch.at[slot, j],
+                    sem.at[1, slot, j]).start()
 
             @pl.when(jnp.logical_and(in_grid, jnp.logical_not(live)))
             def _():
@@ -128,10 +130,10 @@ def _decode_kernel(
             @pl.when(page_start < seq_len)
             def _():
                 pltpu.make_async_copy(
-                    k_hbm.at[block_tables_ref[b, base + j]],
+                    k_hbm.at[lyr, block_tables_ref[b, base + j]],
                     k_scratch.at[slot, j], sem.at[0, slot, j]).wait()
                 pltpu.make_async_copy(
-                    v_hbm.at[block_tables_ref[b, base + j]],
+                    v_hbm.at[lyr, block_tables_ref[b, base + j]],
                     v_scratch.at[slot, j], sem.at[1, slot, j]).wait()
 
     # Warm the pipeline: chunk 0 of each sequence kicks off its own DMA.
@@ -186,23 +188,33 @@ def _decode_kernel(
 @functools.partial(jax.jit, static_argnames=("pages_per_chunk", "interpret"))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,             # (B, H, D)
-    k_pages: jnp.ndarray,       # (P, page_size, H_kv, D)
-    v_pages: jnp.ndarray,       # (P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv, D) or (P, ps, H_kv, D)
+    v_pool: jnp.ndarray,        # same shape as k_pool
     block_tables: jnp.ndarray,  # (B, max_pages) int32
     seq_lens: jnp.ndarray,      # (B,) int32
+    layer: jnp.ndarray | int = 0,  # scalar int32 — pool layer to read
     *,
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Paged decode attention on TPU via Pallas. Returns (B, H, D).
 
-    Drop-in for :func:`llmq_tpu.ops.attention.paged_decode_attention`;
+    Drop-in for :func:`llmq_tpu.ops.attention.paged_decode_attention_pooled`
+    (and for the single-layer reference when given 4-D pools);
     ``interpret=True`` runs the kernel on CPU for tests. Requires
     ``H_kv · D`` to be a multiple of 128 (lane tiling) — true for every
     Llama-3 family member (8·64, 8·128, …).
+
+    The layer index arrives via scalar prefetch, so the pool never
+    needs a per-layer slice materialized — forward_decode's unrolled
+    layer loop passes each static layer index straight through while
+    threading one pool buffer across all layers.
     """
+    if k_pool.ndim == 4:                 # single-layer convenience form
+        k_pool = k_pool[None]
+        v_pool = v_pool[None]
     B, H, D = q.shape
-    P, page_size, Hkv, _ = k_pages.shape
+    L, P, page_size, Hkv, _ = k_pool.shape
     max_pages = block_tables.shape[1]
     n_rep = H // Hkv
     GD = Hkv * D
@@ -218,8 +230,8 @@ def paged_decode_attention_pallas(
     eye = jnp.eye(Hkv, dtype=q.dtype)                      # (g, g')
     q_bd = jnp.einsum("bgrd,gh->bgrhd", q.reshape(B, Hkv, n_rep, D),
                       eye).reshape(B, H, GD)
-    k_flat = k_pages.reshape(P, page_size, GD)
-    v_flat = v_pages.reshape(P, page_size, GD)
+    k_flat = k_pool.reshape(L, P, page_size, GD)
+    v_flat = v_pool.reshape(L, P, page_size, GD)
 
     kernel = functools.partial(
         _decode_kernel,
@@ -229,7 +241,7 @@ def paged_decode_attention_pallas(
         scale=D ** -0.5,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, num_chunks),
         in_specs=[
             pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
@@ -241,8 +253,8 @@ def paged_decode_attention_pallas(
             pltpu.VMEM((H, 1), jnp.float32),
             pltpu.VMEM((H, 1), jnp.float32),
             pltpu.VMEM((H, GD), jnp.float32),
-            pltpu.VMEM((2, ppc, page_size, GD), k_pages.dtype),
-            pltpu.VMEM((2, ppc, page_size, GD), v_pages.dtype),
+            pltpu.VMEM((2, ppc, page_size, GD), k_pool.dtype),
+            pltpu.VMEM((2, ppc, page_size, GD), v_pool.dtype),
             pltpu.SemaphoreType.DMA((2, 2, ppc)),
         ],
     )
@@ -254,6 +266,7 @@ def paged_decode_attention_pallas(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
       q_bd, k_flat, v_flat)
     # Extract each row's diagonal block: (B, H, GD) → (B, H, D).
     out5 = out.reshape(B, Hkv, n_rep, Hkv, D)

@@ -57,13 +57,19 @@ def trace(label: str = "llmq") -> Iterator[None]:
 
 @contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named sub-region inside a device trace (TraceAnnotation)."""
+    """Named sub-region inside a device trace (TraceAnnotation).
+    Annotation setup is best-effort; body exceptions propagate
+    untouched (a blanket try around the yield would trip contextlib's
+    'generator didn't stop after throw()' and mask the real error)."""
     try:
         import jax
-
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        ann = jax.profiler.TraceAnnotation(name)
     except Exception:  # noqa: BLE001 — annotation is best-effort
+        ann = None
+    if ann is None:
+        yield
+        return
+    with ann:
         yield
 
 
@@ -136,15 +142,3 @@ class SpanRecorder:
     def __len__(self) -> int:
         with self._mu:
             return len(self._spans)
-
-
-_global_recorder: Optional[SpanRecorder] = None
-_global_mu = threading.Lock()
-
-
-def get_recorder() -> SpanRecorder:
-    global _global_recorder
-    with _global_mu:
-        if _global_recorder is None:
-            _global_recorder = SpanRecorder()
-        return _global_recorder

@@ -4,7 +4,9 @@ the reference's docs-only pprof/Jaeger recipes)."""
 import json
 import os
 
-from llmq_tpu.utils.profiling import SpanRecorder, get_recorder, trace
+import pytest
+
+from llmq_tpu.utils.profiling import SpanRecorder, annotate, trace
 
 
 class TestSpanRecorder:
@@ -45,8 +47,10 @@ class TestSpanRecorder:
         rec.clear()
         assert rec.snapshot() == []
 
-    def test_global_recorder_singleton(self):
-        assert get_recorder() is get_recorder()
+    def test_annotate_propagates_body_errors(self):
+        with pytest.raises(ValueError, match="original"):
+            with annotate("x"):
+                raise ValueError("original")
 
 
 class TestDeviceTrace:
