@@ -243,7 +243,7 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     from llmq_tpu.models.llama import get_config, init_params, param_count
 
     max_seq = int(os.environ.get("LLMQ_BENCH_SEQ", "1024"))
-    chunk = int(os.environ.get("LLMQ_BENCH_CHUNK", "32"))
+    chunk = int(os.environ.get("LLMQ_BENCH_CHUNK", "64"))
     cfg = get_config(model_name, max_seq_len=max_seq)
     page_size = 16
     pages_per_seq = max_seq // page_size
@@ -332,8 +332,8 @@ def main() -> None:
     rate = float(os.environ.get("LLMQ_BENCH_POISSON_RATE", "1500"))
     secs = float(os.environ.get("LLMQ_BENCH_POISSON_SECS", "5"))
     model = os.environ.get("LLMQ_BENCH_MODEL", "llama3-1b")
-    batch = int(os.environ.get("LLMQ_BENCH_BATCH", "32"))
-    steps = int(os.environ.get("LLMQ_BENCH_DECODE_STEPS", "64"))
+    batch = int(os.environ.get("LLMQ_BENCH_BATCH", "64"))
+    steps = int(os.environ.get("LLMQ_BENCH_DECODE_STEPS", "128"))
 
     qres = bench_queue_throughput(n_msgs)
     tiers = bench_poisson_echo(rate, secs)

@@ -30,9 +30,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from llmq_tpu.ops.attention import (blockwise_prefill_attention,
-                                    dispatch_paged_decode_attention,
-                                    paged_kv_write)
+from llmq_tpu.ops.attention import (dispatch_paged_decode_attention,
+                                    dispatch_prefill_attention,
+                                    paged_kv_write,
+                                    paged_kv_write_prefill)
 from llmq_tpu.ops.norms import rms_norm
 from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -214,87 +215,51 @@ def forward_prefill(
       pages through the same block tables.
     """
     B, T = tokens.shape
-    page_sz = kv_cache["k"].shape[2]
-    max_pages = block_tables.shape[1]
-    S = max_pages * page_sz
 
     h = params["embed"][tokens].astype(cfg.dtype)  # (B, T, D)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)  # (B,T,half)
 
-    # Flat scatter coordinates for the paged write (same for every layer).
-    valid = (jnp.arange(T)[None, :] < lengths[:, None])    # (B, T)
-    flat_valid = valid.reshape(-1)
-    flat_pos = positions.reshape(-1)                       # (B*T,)
-    page_of = jnp.where(
-        flat_valid,
-        block_tables[jnp.repeat(jnp.arange(B), T), flat_pos // page_sz],
-        0)                                                 # padding → page 0
-    slot_of = jnp.where(flat_valid, flat_pos % page_sz, 0)
     # Absolute visible history per row: last valid position + 1.
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])    # (B, T)
     last_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)
     seq_lens = last_pos + 1                                # (B,)
 
-    # Pool flows through the scan as per-layer xs/ys slices. The ys
-    # re-stacking rewrites the pool once per call — amortized over a
-    # whole prefill chunk that is noise, and unlike a carried pool it
-    # never degenerates into per-layer full-pool copies (XLA treats a
-    # carried pool consumed by both a scatter and a gather very
-    # conservatively; measured 4-10x slower). The latency-critical
-    # decode path (forward_decode) is unrolled instead.
-    def layer(h, xs):
-        (wq, wk, wv, wo, w_gate, w_up, w_down, attn_norm, mlp_norm,
-         k_pages, v_pages) = xs
-        hn = rms_norm(h, attn_norm, cfg.norm_eps)
-        q = jnp.dot(hn, wq).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.dot(hn, wk).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.dot(hn, wv).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    # Layers UNROLLED, one stacked pool threaded through per-layer
+    # aliased Pallas writes (B==1 serving prefill) — same structure and
+    # rationale as forward_decode below: any scan formulation makes XLA
+    # materialize pool copies (ys restack per call; carried pools
+    # degrade to per-layer full copies), and XLA scatter costs ~13µs
+    # per row. The pure-JAX fallback (general B / CPU) scatters into
+    # the threaded pool instead.
+    lp = params["layers"]
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    for l in range(cfg.n_layers):
+        hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
+        q = jnp.dot(hn, lp["wq"][l]).reshape(B, T, cfg.n_heads,
+                                             cfg.head_dim)
+        k = jnp.dot(hn, lp["wk"][l]).reshape(B, T, cfg.n_kv_heads,
+                                             cfg.head_dim)
+        v = jnp.dot(hn, lp["wv"][l]).reshape(B, T, cfg.n_kv_heads,
+                                             cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # Write this layer's KV into its page pool.
-        k_pages = k_pages.at[page_of, slot_of].set(
-            k.reshape(-1, cfg.n_kv_heads, cfg.head_dim))
-        v_pages = v_pages.at[page_of, slot_of].set(
-            v.reshape(-1, cfg.n_kv_heads, cfg.head_dim))
+        # Write this layer's KV into its slice of the pool.
+        k_pool, v_pool = paged_kv_write_prefill(
+            k_pool, v_pool, k, v, block_tables, positions, lengths,
+            jnp.int32(l))
         # Attend over the full paged history (covers continuation turns);
         # causality enforced via absolute positions.
-        k_hist = k_pages[block_tables].reshape(
-            B, S, cfg.n_kv_heads, cfg.head_dim)
-        v_hist = v_pages[block_tables].reshape(
-            B, S, cfg.n_kv_heads, cfg.head_dim)
-        attn = _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens)
-        h = h + jnp.dot(attn.reshape(B, T, -1), wo)
-        hn2 = rms_norm(h, mlp_norm, cfg.norm_eps)
-        h = h + _mlp(hn2, w_gate, w_up, w_down)
-        return h, (k_pages, v_pages)
-
-    lp = params["layers"]
-    xs = (lp["wq"], lp["wk"], lp["wv"], lp["wo"], lp["w_gate"], lp["w_up"],
-          lp["w_down"], lp["attn_norm"], lp["mlp_norm"],
-          kv_cache["k"], kv_cache["v"])
-    h, (new_k, new_v) = lax.scan(layer, h, xs)
+        attn = dispatch_prefill_attention(q, k_pool, v_pool, block_tables,
+                                          positions, seq_lens, l)
+        h = h + jnp.dot(attn.reshape(B, T, -1), lp["wo"][l])
+        hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
+        h = h + _mlp(hn2, lp["w_gate"][l], lp["w_up"][l], lp["w_down"][l])
+    new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params.get("lm_head")
     logits = (jnp.dot(h, head) if head is not None
               else jnp.dot(h, params["embed"].T))
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
-
-
-def _prefill_paged_attention(q, k_hist, v_hist, positions, seq_lens):
-    """Causal attention of prefill queries over the paged history.
-
-    q: (B, T, H, D); k_hist/v_hist: (B, S, H_kv, D); positions: (B, T)
-    absolute; visibility: cache slot s belongs to absolute position s' —
-    by construction slot index IS the absolute position (block_tables map
-    position//page_size → page), so the mask is kv_pos <= q_pos and
-    kv_pos < seq_len.
-
-    Delegates to the blockwise online-softmax implementation: peak
-    activation memory stays O(T·block) regardless of the padded window
-    width S, so 8k-context prefill never materializes (B, H, T, S) f32
-    logits (GBs per layer at scale).
-    """
-    return blockwise_prefill_attention(q, k_hist, v_hist, positions,
-                                       seq_lens)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -175,6 +175,87 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
     return k_pool, v_pool
 
 
+def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
+                           lengths, layer):
+    """Write a prefill chunk's KV (k/v: (B, T, H_kv, D)) into layer
+    ``layer`` of the stacked pool.
+
+    B == 1 on TPU (the executor prefills one sequence per call): Pallas
+    page-RMW kernel — the chunk touches T/page_size contiguous pages,
+    each merged and written with two DMAs instead of T ~13µs scatter
+    rows. The chunk's KV is first shifted into a page-aligned buffer
+    (token t at row ``start%page_size + t``) with ONE contiguous
+    dynamic-update-slice so the kernel only needs static block slices.
+    Otherwise (general B, CPU, unaligned heads): an .at[] scatter with
+    coordinates derived from the same block_tables/positions/lengths.
+    """
+    B, T = k.shape[0], k.shape[1]
+    page_size = k_pool.shape[2]
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1))
+    if use_kernel:
+        from llmq_tpu.ops.pallas.kv_write import kv_prefill_write_pallas
+        start = positions[0, 0]
+        n_tok = lengths[0]
+        # Buffer must hold max_offset (page_size-1) + T rows, rounded to
+        # whole pages — T//page_size + 1 under-allocates for non-multiple
+        # buckets and dynamic_update_slice would silently clamp.
+        n_wp = -(-T // page_size) + 1
+        Hkv, D = k.shape[2], k.shape[3]
+        aligned_k = jnp.zeros((n_wp * page_size, Hkv, D), k.dtype)
+        aligned_v = jnp.zeros((n_wp * page_size, Hkv, D), v.dtype)
+        off = start % page_size
+        aligned_k = jax.lax.dynamic_update_slice(aligned_k, k[0],
+                                                 (off, 0, 0))
+        aligned_v = jax.lax.dynamic_update_slice(aligned_v, v[0],
+                                                 (off, 0, 0))
+        return kv_prefill_write_pallas(
+            k_pool, v_pool, aligned_k, aligned_v, block_tables[0],
+            start, n_tok, layer, interpret=interpret)
+    # Scatter coordinates: padding rows (beyond lengths) → page 0.
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])     # (B, T)
+    flat_valid = valid.reshape(-1)
+    flat_pos = positions.reshape(-1)
+    page_of = jnp.where(
+        flat_valid,
+        block_tables[jnp.repeat(jnp.arange(B), T), flat_pos // page_size],
+        0)
+    slot_of = jnp.where(flat_valid, flat_pos % page_size, 0)
+    k_pool = k_pool.at[layer, page_of, slot_of].set(
+        k.reshape(-1, k.shape[2], k.shape[3]))
+    v_pool = v_pool.at[layer, page_of, slot_of].set(
+        v.reshape(-1, v.shape[2], v.shape[3]))
+    return k_pool, v_pool
+
+
+def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
+                               seq_lens, layer) -> jnp.ndarray:
+    """Prefill-chunk attention over the paged pool; q (B, T, H, D).
+
+    B == 1 on TPU: Pallas paged prefill kernel reading the pool
+    directly — an XLA gather between the layers' aliased KV-writes
+    makes XLA insert full-pool defensive copies (measured 3-4x total
+    prefill cost), and the gather also materializes the padded window.
+    Fallback: gather + blockwise online-softmax attention.
+    """
+    B, T = q.shape[0], q.shape[1]
+    page_size = k_pool.shape[2]
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1))
+    if use_kernel:
+        from llmq_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention_pallas)
+        out = paged_prefill_attention_pallas(
+            q[0], k_pool, v_pool, block_tables[0], positions[0, 0],
+            layer, interpret=interpret)
+        return out[None]
+    S = block_tables.shape[1] * page_size
+    Hkv = k_pool.shape[3]
+    D = k_pool.shape[4]
+    k_hist = k_pool[layer, block_tables].reshape(B, S, Hkv, D)
+    v_hist = v_pool[layer, block_tables].reshape(B, S, Hkv, D)
+    return blockwise_prefill_attention(q, k_hist, v_hist, positions,
+                                       seq_lens)
+
+
 def dispatch_paged_decode_attention(q, k_pool, v_pool, block_tables,
                                     seq_lens, layer) -> jnp.ndarray:
     """Route the decode hot path: Pallas kernel on TPU, pure JAX

@@ -19,12 +19,14 @@ double-buffered across rows, with **input/output aliasing** so the pool
 is updated in place. A page round-trip is 2·page_size·GD bytes — for
 B=32, 16 layers that's ~2 MB/step, noise next to the weight traffic.
 
-CORRECTNESS CONSTRAINT: all live rows in one call must target
-**distinct pages** (their RMWs are concurrent). Decode satisfies this
-by construction — each sequence owns its pages; inactive rows all
+CORRECTNESS CONSTRAINT (row kernel): all live rows in one call must
+target **distinct pages** (their RMWs are concurrent). Decode satisfies
+this by construction — each sequence owns its pages; inactive rows all
 target reserved page 0, whose content is never read. Prefill writes
-many slots of the same page and must NOT use this kernel (the
-dispatcher keeps XLA scatter there, amortized over the whole chunk).
+many slots of the same page and uses the second kernel in this module
+(``kv_prefill_write_pallas``): the chunk's contiguous token range is
+shifted into a page-aligned buffer and each touched page is merged and
+written exactly once.
 
 The new rows arrive as a whole (N, GD) VMEM block; row i is extracted
 with an iota-mask reduction (dynamic sublane indexing is as illegal as
@@ -181,5 +183,182 @@ def kv_cache_write_pallas(
     )(page_of.astype(jnp.int32), slot_of.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
       kn, vn, kf, vf)
+    return (k_out.reshape(L, P, page_size, Hkv, D),
+            v_out.reshape(L, P, page_size, Hkv, D))
+
+
+def _kv_prefill_kernel(
+    # scalar prefetch (SMEM)
+    block_table_ref,  # (max_pages,) int32 — the sequence's block table
+    meta_ref,         # (3,) int32 — [start_pos, n_tokens, layer]
+    # inputs
+    k_new_ref,        # (n_wp·ps, GD) VMEM — page-ALIGNED chunk KV
+    v_new_ref,        # (n_wp·ps, GD) VMEM
+    k_hbm,            # (L, P, page_size, GD) ANY — aliased to output 0
+    v_hbm,            # (L, P, page_size, GD) ANY — aliased to output 1
+    # outputs (aliased buffers; DMAs target these)
+    k_out,
+    v_out,
+    # scratch
+    k_page,           # (page_size, GD) VMEM (partial-page RMW)
+    v_page,           # (page_size, GD) VMEM
+    sem,              # DMA semaphores (2, n_wp)
+    rmw_sem,          # DMA semaphores (2,)
+    *,
+    page_size: int,
+    max_pages: int,
+    n_wp: int,
+):
+    """Static unroll over the chunk's pages. Fully-covered pages (the
+    common case — all but the ≤2 edge pages of a chunk) are written
+    with one direct async DMA each, ALL in flight concurrently; partial
+    edge pages do a serial fetch-merge-write so pre-existing slots
+    (continuation prefill) survive. Every page in a call is distinct
+    (consecutive block-table entries), so the writes can't race."""
+    start = meta_ref[0]
+    n_tok = meta_ref[1]
+    lyr = meta_ref[2]
+
+    def page_coords(j):
+        page_idx = start // page_size + j
+        in_table = page_idx < max_pages
+        pid = jnp.where(
+            in_table, block_table_ref[jnp.where(in_table, page_idx, 0)], 0)
+        page_lo = page_idx * page_size
+        write_lo = jnp.maximum(start, page_lo)
+        write_hi = jnp.minimum(start + n_tok, page_lo + page_size)
+        full = jnp.logical_and(write_lo == page_lo,
+                               write_hi == page_lo + page_size)
+        return pid, page_lo, write_lo, write_hi, full
+
+    # Phase 1: kick off every full page's direct write.
+    for j in range(n_wp):  # static unroll
+        pid, _, write_lo, write_hi, full = page_coords(j)
+
+        @pl.when(full)
+        def _():
+            pltpu.make_async_copy(
+                k_new_ref.at[pl.ds(j * page_size, page_size)],
+                k_out.at[lyr, pid], sem.at[0, j]).start()
+            pltpu.make_async_copy(
+                v_new_ref.at[pl.ds(j * page_size, page_size)],
+                v_out.at[lyr, pid], sem.at[1, j]).start()
+
+    # Phase 2: RMW the partial pages (serial; at most 2 per chunk).
+    for j in range(n_wp):
+        pid, page_lo, write_lo, write_hi, full = page_coords(j)
+        partial_pg = jnp.logical_and(write_lo < write_hi,
+                                     jnp.logical_not(full))
+
+        @pl.when(partial_pg)
+        def _():
+            pltpu.make_async_copy(k_hbm.at[lyr, pid], k_page,
+                                  rmw_sem.at[0]).start()
+            pltpu.make_async_copy(v_hbm.at[lyr, pid], v_page,
+                                  rmw_sem.at[1]).start()
+            pltpu.make_async_copy(k_hbm.at[lyr, pid], k_page,
+                                  rmw_sem.at[0]).wait()
+            pltpu.make_async_copy(v_hbm.at[lyr, pid], v_page,
+                                  rmw_sem.at[1]).wait()
+
+            sl = page_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (page_size, 1), 0)              # absolute pos
+            fresh = jnp.logical_and(sl >= write_lo, sl < write_hi)
+            k_page[...] = jnp.where(
+                fresh, k_new_ref[pl.ds(j * page_size, page_size)],
+                k_page[...])
+            v_page[...] = jnp.where(
+                fresh, v_new_ref[pl.ds(j * page_size, page_size)],
+                v_page[...])
+
+            pltpu.make_async_copy(k_page, k_out.at[lyr, pid],
+                                  rmw_sem.at[0]).start()
+            pltpu.make_async_copy(v_page, v_out.at[lyr, pid],
+                                  rmw_sem.at[1]).start()
+            pltpu.make_async_copy(k_page, k_out.at[lyr, pid],
+                                  rmw_sem.at[0]).wait()
+            pltpu.make_async_copy(v_page, v_out.at[lyr, pid],
+                                  rmw_sem.at[1]).wait()
+
+    # Phase 3: drain the full-page writes.
+    for j in range(n_wp):
+        pid, _, _, _, full = page_coords(j)
+
+        @pl.when(full)
+        def _():
+            pltpu.make_async_copy(
+                k_new_ref.at[pl.ds(j * page_size, page_size)],
+                k_out.at[lyr, pid], sem.at[0, j]).wait()
+            pltpu.make_async_copy(
+                v_new_ref.at[pl.ds(j * page_size, page_size)],
+                v_out.at[lyr, pid], sem.at[1, j]).wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def kv_prefill_write_pallas(
+    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D)
+    v_pool: jnp.ndarray,
+    k_aligned: jnp.ndarray,    # (n_wp·page_size, H_kv, D), page-aligned
+    v_aligned: jnp.ndarray,
+    block_table: jnp.ndarray,  # (max_pages,) int32
+    start_pos: jnp.ndarray,    # scalar int32 — absolute pos of token 0
+    n_tokens: jnp.ndarray,     # scalar int32 — valid tokens in the chunk
+    layer: jnp.ndarray | int = 0,
+    *,
+    interpret: bool = False,
+):
+    """Write a prefill chunk's KV into the pool in place (page RMW).
+
+    ``k_aligned`` must hold token t at row ``start_pos % page_size + t``
+    (leading rows are don't-care) — one contiguous dynamic-update-slice
+    for the caller, static page-block slicing for the kernel.
+    """
+    L, P, page_size, Hkv, D = k_pool.shape
+    GD = Hkv * D
+    if GD % 128:
+        raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
+    n_wp = k_aligned.shape[0] // page_size
+    max_pages = block_table.shape[0]
+
+    kernel = functools.partial(_kv_prefill_kernel, page_size=page_size,
+                               max_pages=max_pages, n_wp=n_wp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),     # single program; pages statically unrolled inside
+        in_specs=[
+            pl.BlockSpec((n_wp * page_size, GD), lambda c, *_: (0, 0)),
+            pl.BlockSpec((n_wp * page_size, GD), lambda c, *_: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((page_size, GD), k_pool.dtype),
+            pltpu.VMEM((page_size, GD), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, n_wp)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    meta = jnp.stack([jnp.asarray(start_pos, jnp.int32),
+                      jnp.asarray(n_tokens, jnp.int32),
+                      jnp.asarray(layer, jnp.int32)])
+    kf = k_pool.reshape(L, P, page_size, GD)
+    vf = v_pool.reshape(L, P, page_size, GD)
+    k_out, v_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(kf.shape, kf.dtype),
+                   jax.ShapeDtypeStruct(vf.shape, vf.dtype)],
+        input_output_aliases={4: 0, 5: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), meta,
+      k_aligned.reshape(-1, GD).astype(k_pool.dtype),
+      v_aligned.reshape(-1, GD).astype(v_pool.dtype),
+      kf, vf)
     return (k_out.reshape(L, P, page_size, Hkv, D),
             v_out.reshape(L, P, page_size, Hkv, D))

@@ -115,6 +115,150 @@ class TestPagedDecodeKernel:
         jax.clear_caches()  # don't leak interpret-mode traces to others
 
 
+class TestKvWriteKernels:
+    def test_decode_row_write(self):
+        from llmq_tpu.ops.pallas.kv_write import kv_cache_write_pallas
+        rng = np.random.default_rng(0)
+        L, P, ps, Hkv, D, N = 3, 40, 8, 2, 64, 12
+        k = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)), jnp.float32)
+        kn = jnp.asarray(rng.standard_normal((N, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((N, Hkv, D)), jnp.float32)
+        page = jnp.asarray(np.arange(1, N + 1), jnp.int32)   # distinct
+        slot = jnp.asarray(np.arange(N) % ps, jnp.int32)
+        ref_k = k.at[1, page, slot].set(kn)
+        ref_v = v.at[1, page, slot].set(vn)
+        ok, ov = kv_cache_write_pallas(k, v, kn, vn, page, slot, 1,
+                                       interpret=True)
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(ref_k))
+        np.testing.assert_array_equal(np.asarray(ov), np.asarray(ref_v))
+
+    @pytest.mark.parametrize("start,n_tok", [(0, 32), (5, 20), (13, 32),
+                                             (8, 8), (19, 1)])
+    def test_prefill_page_write(self, start, n_tok):
+        """Page-RMW prefill write == scatter, incl. partial edge pages
+        and preservation of pre-existing KV before the chunk start."""
+        from llmq_tpu.ops.pallas.kv_write import kv_prefill_write_pallas
+        rng = np.random.default_rng(start * 100 + n_tok)
+        L, P, ps, Hkv, D = 2, 16, 8, 2, 64
+        mp = 8                                    # block-table width
+        k = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)), jnp.float32)
+        bt = jnp.asarray(rng.permutation(np.arange(1, P))[:mp], jnp.int32)
+        kn = jnp.asarray(rng.standard_normal((n_tok, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((n_tok, Hkv, D)), jnp.float32)
+        # scatter reference
+        pos = start + np.arange(n_tok)
+        page = np.asarray(bt)[pos // ps]
+        slot = pos % ps
+        ref_k = k.at[1, page, slot].set(kn)
+        ref_v = v.at[1, page, slot].set(vn)
+        # kernel: page-aligned buffer, bucket length T >= n_tok
+        T = 32
+        n_wp = T // ps + 1
+        ak = np.zeros((n_wp * ps, Hkv, D), np.float32)
+        av = np.zeros((n_wp * ps, Hkv, D), np.float32)
+        off = start % ps
+        ak[off:off + n_tok] = kn
+        av[off:off + n_tok] = vn
+        ok, ov = kv_prefill_write_pallas(
+            k, v, jnp.asarray(ak), jnp.asarray(av), bt,
+            jnp.int32(start), jnp.int32(n_tok), 1, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(ref_k))
+        np.testing.assert_array_equal(np.asarray(ov), np.asarray(ref_v))
+
+    def test_prefill_write_nonmultiple_bucket(self, monkeypatch):
+        """Bucket T not a multiple of page_size with a mid-page
+        continuation start: the aligned buffer must not clamp (review
+        regression: T//ps+1 pages under-allocated → silent KV shift)."""
+        from llmq_tpu.ops.attention import paged_kv_write_prefill
+        rng = np.random.default_rng(7)
+        L, P, ps, Hkv, D = 2, 16, 16, 2, 64
+        T, start, n_tok = 24, 28, 24         # off=12, off+T=36 > 2*ps
+        mp = 8
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        bt = jnp.asarray(np.arange(1, mp + 1), jnp.int32)[None]
+        k = jnp.asarray(rng.standard_normal((1, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, T, Hkv, D)), jnp.float32)
+        positions = (start + jnp.arange(T))[None].astype(jnp.int32)
+        lengths = jnp.asarray([n_tok], jnp.int32)
+        monkeypatch.setenv("LLMQ_PALLAS", "0")
+        jax.clear_caches()
+        rk, rv = paged_kv_write_prefill(k_pool, v_pool, k, v, bt,
+                                        positions, lengths, 1)
+        monkeypatch.setenv("LLMQ_PALLAS", "interpret")
+        jax.clear_caches()
+        ok, ov = paged_kv_write_prefill(k_pool, v_pool, k, v, bt,
+                                        positions, lengths, 1)
+        jax.clear_caches()
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(rk))
+        np.testing.assert_array_equal(np.asarray(ov), np.asarray(rv))
+
+    def test_forward_prefill_dispatch_interpret(self, monkeypatch):
+        """forward_prefill B=1 routes through the prefill-write kernel
+        under LLMQ_PALLAS=interpret and matches the scatter path."""
+        from llmq_tpu.models.llama import (forward_prefill, get_config,
+                                           init_kv_pages, init_params)
+        cfg = get_config("llama3-tiny", max_seq_len=64, dim=256,
+                         n_heads=4, n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray([[5, 9, 2, 7, 1, 3, 8, 4]], jnp.int32)
+        pos = jnp.arange(8)[None, :].astype(jnp.int32)
+        lens = jnp.asarray([8], jnp.int32)
+        bt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        monkeypatch.setenv("LLMQ_PALLAS", "0")
+        jax.clear_caches()
+        cache = init_kv_pages(cfg, 16, 8)
+        ref_logits, ref_cache = forward_prefill(params, cfg, toks, pos,
+                                                lens, cache, bt)
+        monkeypatch.setenv("LLMQ_PALLAS", "interpret")
+        jax.clear_caches()
+        cache = init_kv_pages(cfg, 16, 8)
+        out_logits, out_cache = forward_prefill(params, cfg, toks, pos,
+                                                lens, cache, bt)
+        jax.clear_caches()
+        np.testing.assert_allclose(np.asarray(out_logits),
+                                   np.asarray(ref_logits),
+                                   atol=3e-2, rtol=3e-2)
+        # written pages identical (pages 1..4 hold the 8 tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_cache["k"][:, 1:5]),
+            np.asarray(ref_cache["k"][:, 1:5]), atol=3e-2, rtol=3e-2)
+
+
+class TestPrefillAttentionKernel:
+    @pytest.mark.parametrize("start", [0, 24])
+    def test_matches_blockwise(self, start):
+        """Paged prefill attention kernel == gather + blockwise, for a
+        fresh prompt (start=0) and a continuation chunk (start=24)."""
+        from llmq_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention_pallas)
+        rng = np.random.default_rng(start)
+        L, P, ps, Hkv, D, H = 2, 24, 8, 2, 64, 4
+        T, mp = 16, 8
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        bt = jnp.asarray(rng.permutation(np.arange(1, P))[:mp], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((1, T, H, D)), jnp.float32)
+        positions = (start + jnp.arange(T))[None, :].astype(jnp.int32)
+        seq_lens = jnp.asarray([start + T], jnp.int32)
+
+        k_hist = k_pool[1, bt[None]].reshape(1, mp * ps, Hkv, D)
+        v_hist = v_pool[1, bt[None]].reshape(1, mp * ps, Hkv, D)
+        ref = blockwise_prefill_attention(q, k_hist, v_hist, positions,
+                                          seq_lens)
+        out = paged_prefill_attention_pallas(
+            q[0], k_pool, v_pool, bt, jnp.int32(start), 1,
+            pages_per_chunk=2, q_block=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref[0]),
+                                   atol=3e-2, rtol=3e-2)
+
+
 class TestBlockwisePrefill:
     def test_matches_full_softmax(self):
         rng = np.random.default_rng(4)
