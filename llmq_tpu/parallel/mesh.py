@@ -51,16 +51,27 @@ def single_device_mesh(axis_names: Sequence[str] = ("dp", "tp")) -> Mesh:
 
 def distributed_init(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     initialization_timeout: Optional[int] = None) -> None:
     """Multi-host bring-up: ``jax.distributed.initialize`` — the DCN-side
     coordination service (role of MPI ranks / NCCL bootstrap in GPU
-    stacks). No-ops when already initialised or single-process."""
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id)
-        log.info("jax.distributed initialised: process %d of %d",
-                 jax.process_index(), jax.process_count())
-    except RuntimeError as e:
-        log.info("jax.distributed not (re)initialised: %s", e)
+    stacks). Idempotent: re-initialising an already-initialised runtime
+    is a no-op; any OTHER failure (bad coordinator address, rank
+    mismatch, timeout) propagates — a half-initialised multi-host
+    serving process must fail fast, not limp along single-host.
+
+    Exercised for real by tests/test_distributed.py: two OS processes
+    rendezvous on a local coordinator and run a cross-process
+    allgather over the CPU backend."""
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        log.info("jax.distributed already initialised")
+        return
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id, **kwargs)
+    log.info("jax.distributed initialised: process %d of %d",
+             jax.process_index(), jax.process_count())

@@ -1,0 +1,122 @@
+"""Multi-process distributed backend (SURVEY §2.2 "Distributed
+communication backend"): two OS processes rendezvous through
+``distributed_init`` (the DCN coordination analogue of NCCL/MPI
+bootstrap) and run a cross-process collective on the CPU backend —
+the same code path a multi-host v5e-16 deployment uses (BASELINE
+config #5), minus the ICI.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+import jax
+from llmq_tpu.parallel.mesh import distributed_init, make_mesh
+
+distributed_init(coordinator={coord!r}, num_processes=2,
+                 process_id={pid}, initialization_timeout=60)
+# Idempotency: a second call must be a clean no-op.
+distributed_init(coordinator={coord!r}, num_processes=2,
+                 process_id={pid})
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())   # 2 per process
+
+# Cross-process collective: allgather each process's rank.
+from jax.experimental import multihost_utils
+got = multihost_utils.process_allgather(np.asarray([jax.process_index()]))
+assert sorted(np.asarray(got).ravel().tolist()) == [0, 1], got
+
+# A global mesh spanning both processes compiles + executes a psum.
+mesh = make_mesh({{"dp": 4}})
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+x = jax.make_array_from_callback(
+    (4,), NamedSharding(mesh, P("dp")),
+    lambda idx: np.ones((1,), np.float32))
+total = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == 4.0, float(total)
+print(f"proc {{jax.process_index()}} OK", flush=True)
+"""
+
+
+def _clean_env():
+    """Child env with a guaranteed-CPU jax: some dev images pre-import
+    jax with a device plugin via a PYTHONPATH site hook BEFORE the
+    child script runs, which latches the platform and (worse) its own
+    distributed runtime — strip the hook and force CPU by env."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))
+           and k not in ("PYTHONPATH", "PYTHONSTARTUP")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return env
+
+
+@pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+def test_two_process_rendezvous_and_collective(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    procs = []
+    try:
+        for pid in range(2):
+            script = _WORKER.format(repo=repo, coord=coord, pid=pid)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=_clean_env(),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    finally:
+        for p in procs:   # no leaked workers on rendezvous timeout
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+    assert any("proc 0 OK" in o for o in outs)
+    assert any("proc 1 OK" in o for o in outs)
+
+
+def test_bad_coordinator_fails_fast():
+    """distributed_init must FAIL FAST on a genuinely bad setup, not
+    swallow the error and limp along single-host (round-1 advisory).
+    jax's client surfaces a dead coordinator as a fatal abort (absl
+    FATAL from the coordination service) — either way the process must
+    die with a distributed-error diagnostic, never print SWALLOWED."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from llmq_tpu.parallel.mesh import distributed_init\n"
+        "try:\n"
+        "    distributed_init(coordinator='127.0.0.1:1',"
+        " num_processes=2, process_id=1, initialization_timeout=5)\n"
+        "except Exception:\n"
+        "    print('RAISED', flush=True); raise SystemExit(0)\n"
+        "print('SWALLOWED', flush=True); raise SystemExit(1)\n")
+    env = _clean_env()
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=90)
+    out = p.stdout + p.stderr
+    assert "SWALLOWED" not in out, out
+    if "RAISED" not in out:   # fatal-abort path
+        assert p.returncode != 0, out
+        assert ("DEADLINE_EXCEEDED" in out
+                or "CoordinationService" in out
+                or "distributed service" in out), out
