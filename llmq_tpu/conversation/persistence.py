@@ -166,18 +166,26 @@ class SqliteStore:
 class RedisStore:
     """Key scheme parity with the reference (persistence.go:46-82):
     ``{prefix}{conv_id}`` JSON blob + ``{prefix}user:{user_id}`` set,
-    with TTL. Requires a redis client library at construction."""
+    with TTL.
+
+    ``client`` injects any redis-protocol client (tests use an
+    in-memory double implementing get/set/sadd/smembers/srem/delete/
+    expire/pipeline — tests/test_conversation.py); by default the
+    ``redis`` package is required at construction."""
 
     def __init__(self, url: str = "redis://localhost:6379/0",
-                 prefix: str = "llmq:", ttl: float = 24 * 3600.0) -> None:
-        try:
-            import redis  # type: ignore[import-not-found]
-        except ImportError as e:
-            raise RuntimeError(
-                "RedisStore requires the 'redis' package, which is not "
-                "installed in this environment; use backend 'sqlite' or "
-                "'memory'") from e
-        self._r = redis.Redis.from_url(url)
+                 prefix: str = "llmq:", ttl: float = 24 * 3600.0,
+                 client=None) -> None:
+        if client is None:
+            try:
+                import redis  # type: ignore[import-not-found]
+            except ImportError as e:
+                raise RuntimeError(
+                    "RedisStore requires the 'redis' package, which is not "
+                    "installed in this environment; use backend 'sqlite' "
+                    "or 'memory'") from e
+            client = redis.Redis.from_url(url)
+        self._r = client
         self._prefix = prefix
         self._ttl = int(ttl)
 

@@ -329,6 +329,30 @@ class JaxExecutor:
             return spec.eos_id
         return int(tok)
 
+    def prefill_async(self, tokens: List[int], start_pos: int,
+                      block_table: np.ndarray, temperature: float):
+        """Single-bucket prefill WITHOUT the host sync: returns the
+        sampled first token as a device array (fetch it when needed).
+        Steady-state admission throughput — benchmarks and future
+        sync-free engine paths; tokens must fit the largest bucket."""
+        jnp = self._jnp
+        T = self._bucket_for(len(tokens))
+        if len(tokens) > self.prefill_buckets[-1]:
+            raise ValueError("prefill_async requires a single-bucket chunk")
+        padded = np.zeros(T, np.int32)
+        padded[: len(tokens)] = tokens
+        positions = np.minimum(start_pos + np.arange(T),
+                               start_pos + len(tokens) - 1)
+        tok, self.cache = self._prefill_step(
+            self.params, self.cache,
+            jnp.asarray(padded)[None, :],
+            jnp.asarray(positions, jnp.int32)[None, :],
+            jnp.asarray([len(tokens)], jnp.int32),
+            jnp.asarray(block_table, jnp.int32)[None, :],
+            jnp.asarray([temperature], jnp.float32),
+            self._next_key())
+        return tok
+
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray,
                temperatures: np.ndarray) -> np.ndarray:

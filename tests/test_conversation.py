@@ -7,7 +7,7 @@ import pytest
 
 from llmq_tpu.core.config import ConversationConfig
 from llmq_tpu.core.errors import ConversationNotFoundError
-from llmq_tpu.core.types import ConversationState, Message
+from llmq_tpu.core.types import Conversation, ConversationState, Message
 from llmq_tpu.conversation import InMemoryStore, SqliteStore, StateManager
 
 
@@ -166,3 +166,112 @@ class TestCaps:
             sm.create(f"u{i}")
             fake_clock.advance(1.0)
         assert sm.count() == 2
+
+
+class _FakePipeline:
+    def __init__(self, r):
+        self._r = r
+        self._ops = []
+
+    def __getattr__(self, name):
+        def op(*a, **kw):
+            self._ops.append((name, a, kw))
+            return self
+        return op
+
+    def execute(self):
+        for name, a, kw in self._ops:
+            getattr(self._r, name)(*a, **kw)
+        self._ops = []
+
+
+class _FakeRedis:
+    """Minimal redis-protocol double covering exactly what RedisStore
+    uses (get/set/sadd/smembers/srem/delete/expire/pipeline/close);
+    values round-trip as bytes like the real client."""
+
+    def __init__(self):
+        self.kv = {}
+        self.sets = {}
+        self.ttls = {}
+
+    def set(self, k, v, ex=None):
+        self.kv[k] = v.encode() if isinstance(v, str) else v
+        if ex is not None:
+            self.ttls[k] = ex
+
+    def get(self, k):
+        return self.kv.get(k)
+
+    def delete(self, k):
+        self.kv.pop(k, None)
+        self.sets.pop(k, None)
+
+    def sadd(self, k, *members):
+        self.sets.setdefault(k, set()).update(
+            m.encode() if isinstance(m, str) else m for m in members)
+
+    def smembers(self, k):
+        return set(self.sets.get(k, set()))
+
+    def srem(self, k, *members):
+        s = self.sets.get(k, set())
+        for m in members:
+            s.discard(m.encode() if isinstance(m, str) else m)
+
+    def expire(self, k, ttl):
+        self.ttls[k] = ttl
+
+    def pipeline(self):
+        return _FakePipeline(self)
+
+    def close(self):
+        pass
+
+
+class TestRedisStore:
+    """RedisStore against an injected in-memory client: exercises the
+    reference's key scheme (persistence.go:46-82) — {prefix}{conv_id}
+    JSON blob + {prefix}user:{uid} membership set, TTL on both."""
+
+    @pytest.fixture
+    def rstore(self):
+        from llmq_tpu.conversation.persistence import RedisStore
+        fake = _FakeRedis()
+        return RedisStore(prefix="llmq:", ttl=3600, client=fake), fake
+
+    def test_save_load_roundtrip(self, rstore):
+        store, fake = rstore
+        conv = Conversation(id="c1", user_id="u1")
+        conv.messages.append(Message(id="m1", content="hi", user_id="u1"))
+        store.save(conv)
+        assert "llmq:c1" in fake.kv                      # blob key
+        assert b"c1" in fake.sets["llmq:user:u1"]        # membership set
+        assert fake.ttls["llmq:c1"] == 3600              # TTL applied
+        got = store.load("c1")
+        assert got is not None and got.id == "c1"
+        assert got.messages[0].content == "hi"
+
+    def test_list_user_and_delete(self, rstore):
+        store, fake = rstore
+        for i in range(3):
+            store.save(Conversation(id=f"c{i}", user_id="u1"))
+        assert store.list_user("u1") == ["c0", "c1", "c2"]
+        store.delete("c1")
+        assert store.load("c1") is None
+        assert store.list_user("u1") == ["c0", "c2"]
+
+    def test_state_manager_over_redis(self, fake_clock):
+        """The unified conversation service runs end-to-end over the
+        redis backend: restart reloads from the store."""
+        from llmq_tpu.conversation.persistence import RedisStore
+        fake = _FakeRedis()
+        cfg = ConversationConfig(persist=True)
+        sm = StateManager(cfg, store=RedisStore(client=fake),
+                          clock=fake_clock)
+        conv = sm.create("u9")
+        sm.add_message(conv.id, Message(id="m", content="x", user_id="u9"))
+        sm2 = StateManager(cfg, store=RedisStore(client=fake),
+                           clock=fake_clock)
+        got = sm2.get(conv.id)
+        assert got is not None and got.messages[0].content == "x"
