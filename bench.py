@@ -294,9 +294,10 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     t0 = time.perf_counter()
     for _ in range(n_pipe):
         tok = ex.prefill_async(list(pf_toks), prompt_len, bt[0], 0.0)
-    _ = np.asarray(tok)  # real completion fence (block_until_ready can
+    # np.asarray is the real completion fence: block_until_ready can
+    # under-wait on tunneled runtimes.
+    _ = np.asarray(tok)
     prefill_pipe_tps = n_pipe * pf_tokens / (time.perf_counter() - t0)
-    # under-wait on tunneled runtimes)
 
     # Decode: chunked program — sampling/EOS stay on device, one host
     # round-trip per `chunk` tokens (host sync latency amortized).

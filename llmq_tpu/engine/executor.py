@@ -299,6 +299,28 @@ class JaxExecutor:
 
     # -- Executor API --------------------------------------------------------
 
+    def _prefill_chunk(self, chunk: List[int], start_pos: int, bt,
+                       temperature: float):
+        """Launch ONE bucketed prefill program (no host sync): pads the
+        chunk to its bucket, clamps padding positions, updates the
+        donated cache. Returns the sampled-token device array."""
+        jnp = self._jnp
+        T = self._bucket_for(len(chunk))
+        padded = np.zeros(T, np.int32)
+        padded[: len(chunk)] = chunk
+        positions = np.minimum(start_pos + np.arange(T),
+                               start_pos + len(chunk) - 1)
+        with annotate(f"prefill_b{T}"):  # named region in xprof traces
+            tok, self.cache = self._prefill_step(
+                self.params, self.cache,
+                jnp.asarray(padded)[None, :],
+                jnp.asarray(positions, jnp.int32)[None, :],
+                jnp.asarray([len(chunk)], jnp.int32),
+                bt,
+                jnp.asarray([temperature], jnp.float32),
+                self._next_key())
+        return tok
+
     def prefill(self, tokens: List[int], start_pos: int,
                 block_table: np.ndarray, temperature: float,
                 slot: int) -> int:
@@ -311,19 +333,7 @@ class JaxExecutor:
         while remaining:
             chunk = remaining[: self.prefill_buckets[-1]]
             remaining = remaining[len(chunk):]
-            T = self._bucket_for(len(chunk))
-            padded = np.zeros(T, np.int32)
-            padded[: len(chunk)] = chunk
-            positions = np.minimum(pos + np.arange(T), pos + len(chunk) - 1)
-            with annotate(f"prefill_b{T}"):  # named region in xprof traces
-                tok, self.cache = self._prefill_step(
-                    self.params, self.cache,
-                    jnp.asarray(padded)[None, :],
-                    jnp.asarray(positions, jnp.int32)[None, :],
-                    jnp.asarray([len(chunk)], jnp.int32),
-                    bt,
-                    jnp.asarray([temperature], jnp.float32),
-                    self._next_key())
+            tok = self._prefill_chunk(chunk, pos, bt, temperature)
             pos += len(chunk)
         if tok is None:
             return spec.eos_id
@@ -335,23 +345,10 @@ class JaxExecutor:
         sampled first token as a device array (fetch it when needed).
         Steady-state admission throughput — benchmarks and future
         sync-free engine paths; tokens must fit the largest bucket."""
-        jnp = self._jnp
-        T = self._bucket_for(len(tokens))
         if len(tokens) > self.prefill_buckets[-1]:
             raise ValueError("prefill_async requires a single-bucket chunk")
-        padded = np.zeros(T, np.int32)
-        padded[: len(tokens)] = tokens
-        positions = np.minimum(start_pos + np.arange(T),
-                               start_pos + len(tokens) - 1)
-        tok, self.cache = self._prefill_step(
-            self.params, self.cache,
-            jnp.asarray(padded)[None, :],
-            jnp.asarray(positions, jnp.int32)[None, :],
-            jnp.asarray([len(tokens)], jnp.int32),
-            jnp.asarray(block_table, jnp.int32)[None, :],
-            jnp.asarray([temperature], jnp.float32),
-            self._next_key())
-        return tok
+        bt = self._jnp.asarray(block_table, self._jnp.int32)[None, :]
+        return self._prefill_chunk(list(tokens), start_pos, bt, temperature)
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray,

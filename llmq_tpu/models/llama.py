@@ -30,9 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from llmq_tpu.ops.attention import (dispatch_paged_decode_attention,
-                                    dispatch_prefill_attention,
-                                    paged_kv_write,
+from llmq_tpu.ops.attention import (dispatch_prefill_attention,
+                                    paged_decode_step,
                                     paged_kv_write_prefill)
 from llmq_tpu.ops.norms import rms_norm
 from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
@@ -311,14 +310,11 @@ def forward_decode(
         q = apply_rope(q, cos, sin)[:, 0]                  # (B, H, D)
         k = apply_rope(k, cos, sin)[:, 0]                  # (B, H_kv, D)
         v = v[:, 0]
-        # distinct_pages: every live sequence owns its page this step
-        # (inactive rows share reserved page 0, never read).
-        k_pool, v_pool = paged_kv_write(k_pool, v_pool, k, v,
-                                        page_of, slot_of, l,
-                                        distinct_pages=True)
-        attn = dispatch_paged_decode_attention(
-            q, k_pool, v_pool, block_tables, seq_lens,
-            jnp.int32(l))                                  # (B, H, D)
+        # Fused write + attention (every live sequence owns its page
+        # this step; inactive rows redirect to reserved page 0).
+        attn, k_pool, v_pool = paged_decode_step(
+            q, k, v, k_pool, v_pool, block_tables, seq_lens,
+            page_of, slot_of, jnp.int32(l))                # (B, H, D)
         h = h + jnp.dot(attn.reshape(B, -1), lp["wo"][l])
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, lp["w_gate"][l], lp["w_up"][l], lp["w_down"][l])

@@ -12,7 +12,7 @@ from llmq_tpu.ops.rope import apply_rope, rope_cos_sin  # noqa: F401
 from llmq_tpu.ops.attention import (  # noqa: F401
     blockwise_prefill_attention,
     causal_prefill_attention,
-    dispatch_paged_decode_attention,
+    paged_decode_step,
     paged_decode_attention,
 )
 from llmq_tpu.ops.sampling import greedy, sample_token  # noqa: F401

@@ -15,8 +15,9 @@ Two implementations of the decode hot path:
   only live pages HBM→VMEM with double-buffered DMA and an online
   softmax; tested against this module in tests/test_pallas.py.
 
-:func:`dispatch_paged_decode_attention` picks between them (TPU →
-kernel, else pure JAX; ``LLMQ_PALLAS=0`` forces the fallback).
+:func:`paged_decode_step` routes each decode layer (TPU → fused
+Pallas write+attention kernel, else scatter + pure JAX;
+``LLMQ_PALLAS=0`` forces the fallback).
 :func:`blockwise_prefill_attention` is the memory-bounded prefill
 (online softmax over KV chunks — no (B, H, T, S) f32 logits tensor).
 """
@@ -256,25 +257,32 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
                                        seq_lens)
 
 
-def dispatch_paged_decode_attention(q, k_pool, v_pool, block_tables,
-                                    seq_lens, layer) -> jnp.ndarray:
-    """Route the decode hot path: Pallas kernel on TPU, pure JAX
-    elsewhere. Pools are stacked-layer (L, P, page_size, H_kv, D);
-    ``layer`` selects the layer inside the op, so forward_decode's
-    unrolled layer loop threads ONE pool buffer through all layers'
-    aliased writes and reads (llama.py explains why the loop is
-    unrolled rather than scanned). ``LLMQ_PALLAS=0`` forces pure JAX
-    (e.g. to A/B the kernel on hardware); ``LLMQ_PALLAS=interpret``
-    runs the kernel in interpret mode (CI without a TPU)."""
+def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
+                      seq_lens, page_of, slot_of, layer):
+    """One decode layer's KV write + attention, fused where possible.
+
+    TPU: ONE Pallas kernel does both — the current token's K/V is
+    merged into the attention's own page fetch (in-register self-
+    attention for the newest token) and the merged page is written back
+    through the aliased pool, halving per-layer kernel launches and
+    dropping the write kernel's separate page round-trip. Fallback:
+    the row-RMW write kernel / scatter followed by pooled attention.
+    Returns (attn, k_pool, v_pool).
+    """
     use_kernel, interpret = _kernel_route(k_pool)
     if use_kernel:
-        from llmq_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention_pallas)
-        return paged_decode_attention_pallas(
-            q, k_pool, v_pool, block_tables, seq_lens, layer,
-            interpret=interpret)
-    return paged_decode_attention_pooled(q, k_pool, v_pool, block_tables,
+        from llmq_tpu.ops.pallas.fused_decode import (
+            fused_decode_attention_pallas)
+        attn, (k_pool, v_pool) = fused_decode_attention_pallas(
+            q, k_new, v_new, k_pool, v_pool, block_tables, seq_lens,
+            page_of, layer, interpret=interpret)
+        return attn, k_pool, v_pool
+    k_pool, v_pool = paged_kv_write(k_pool, v_pool, k_new, v_new,
+                                    page_of, slot_of, layer,
+                                    distinct_pages=True)
+    attn = paged_decode_attention_pooled(q, k_pool, v_pool, block_tables,
                                          seq_lens, layer)
+    return attn, k_pool, v_pool
 
 
 def blockwise_prefill_attention(

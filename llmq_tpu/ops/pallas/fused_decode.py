@@ -1,0 +1,295 @@
+"""Pallas TPU kernel: FUSED decode attention + KV-cache write.
+
+One kernel per layer instead of two (kv_write + paged_attention): the
+per-layer pallas-call launch overhead is a measurable slice of the
+decode step (32 launches/step at 16 layers), and the separate write
+kernel pays its own page round-trip that this kernel already makes.
+
+How the fusion works, per sequence row b:
+
+- The current token's K/V row does NOT go through HBM before attention.
+  The kernel DMAs the history pages as usual; when the chunk containing
+  the current position arrives in VMEM, the new row is **merged into
+  the fetched scratch** (vector select at the page/slot offset), the
+  merged page is DMA'd back to the pool (input/output-aliased — this IS
+  the cache write), and attention computes over the merged scratch — so
+  the current token attends to itself without ever reading its own
+  stale slot.
+- Masking is ``kv_pos < seq_len`` with ``seq_len = pos+1`` — identical
+  to the unfused semantics, because the merged scratch holds the
+  current token at its true slot.
+- Inactive rows (EOS-latched inside a decode chunk) redirect their
+  write to reserved page 0 (never read); their attention output is
+  discarded by the engine.
+
+Same shape strategy as the other kernels: block-diagonal Q
+(one 2D MXU matmul for all heads), pages flattened to (ps, H_kv·D),
+online softmax in f32 scratch, double-buffered chunk DMA, dead chunks
+skipped. Constraint: all live rows target distinct pages (decode
+invariant), H_kv·D % 128 == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fused_kernel(
+    # scalar prefetch (SMEM)
+    block_tables_ref,   # (B, max_pages) int32
+    seq_lens_ref,       # (B,) int32 — pos+1 (current token included)
+    write_page_ref,     # (B,) int32 — pool page id for the current token
+    layer_ref,          # (1,) int32
+    # inputs
+    q_ref,              # (1, H, GD) VMEM — block-diagonal
+    k_new_ref,          # (B_pad, GD) VMEM — current tokens' K rows
+    v_new_ref,          # (B_pad, GD) VMEM
+    k_hbm,              # (L, P, ps, GD) ANY — aliased to output 1
+    v_hbm,              # (L, P, ps, GD) ANY — aliased to output 2
+    # outputs
+    out_ref,            # (1, H, GD) VMEM — attention output
+    k_out,              # aliased pools (DMAs target these)
+    v_out,
+    # scratch
+    m_ref, l_ref, acc_ref,          # (H,1),(H,1),(H,GD) f32
+    k_scratch, v_scratch,           # (2, ppc, ps, GD) VMEM
+    sem,                            # DMA (2, 2, ppc)
+    wsem,                           # DMA (2,) — merged-page writeback
+    *,
+    pages_per_chunk: int,
+    page_size: int,
+    num_chunks: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    ppc = pages_per_chunk
+    seq_len = seq_lens_ref[b]
+    lyr = layer_ref[0]
+    cur_pos = seq_len - 1
+    cur_page_j = cur_pos // page_size       # page index within the table
+    cur_chunk = cur_page_j // ppc
+    n_pad = k_new_ref.shape[0]
+
+    def start_chunk(chunk, slot):
+        base = chunk * ppc
+        for j in range(ppc):
+            page_start = (base + j) * page_size
+            in_grid = chunk < num_chunks
+            live = jnp.logical_and(in_grid, page_start < seq_len)
+
+            @pl.when(live)
+            def _():
+                pid = block_tables_ref[b, base + j]
+                pltpu.make_async_copy(
+                    k_hbm.at[lyr, pid], k_scratch.at[slot, j],
+                    sem.at[0, slot, j]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[lyr, pid], v_scratch.at[slot, j],
+                    sem.at[1, slot, j]).start()
+
+            @pl.when(jnp.logical_and(in_grid, jnp.logical_not(live)))
+            def _():
+                v_scratch[slot, j] = jnp.zeros_like(v_scratch[slot, j])
+
+    def wait_chunk(chunk, slot):
+        base = chunk * ppc
+        for j in range(ppc):
+            page_start = (base + j) * page_size
+
+            @pl.when(page_start < seq_len)
+            def _():
+                pltpu.make_async_copy(
+                    k_hbm.at[lyr, block_tables_ref[b, base + j]],
+                    k_scratch.at[slot, j], sem.at[0, slot, j]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[lyr, block_tables_ref[b, base + j]],
+                    v_scratch.at[slot, j], sem.at[1, slot, j]).wait()
+
+    @pl.when(c == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        start_chunk(0, 0)
+
+    slot = jax.lax.rem(c, 2)
+    chunk_start = c * ppc * page_size
+
+    @pl.when(chunk_start < seq_len)
+    def _():
+        start_chunk(c + 1, 1 - slot)
+        wait_chunk(c, slot)
+
+        # Merge the current token's row into the freshly fetched page
+        # and write the merged page back — the fused cache write.
+        @pl.when(c == cur_chunk)
+        def _():
+            jj = cur_page_j - cur_chunk * ppc          # page within chunk
+            s = cur_pos - cur_page_j * page_size       # slot within page
+            rows = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 1), 0)
+            msk = (rows == b).astype(jnp.float32)
+            k_row = jnp.sum(k_new_ref[...].astype(jnp.float32) * msk,
+                            axis=0, keepdims=True)     # (1, GD)
+            v_row = jnp.sum(v_new_ref[...].astype(jnp.float32) * msk,
+                            axis=0, keepdims=True)
+            # jj/s are traced: select the page via per-page `when`.
+            for j in range(ppc):
+                @pl.when(j == jj)
+                def _():
+                    sl = jax.lax.broadcasted_iota(
+                        jnp.int32, (page_size, 1), 0)
+                    keep = sl != s
+                    k_scratch[slot, j] = jnp.where(
+                        keep, k_scratch[slot, j],
+                        k_row.astype(k_scratch.dtype))
+                    v_scratch[slot, j] = jnp.where(
+                        keep, v_scratch[slot, j],
+                        v_row.astype(v_scratch.dtype))
+                    wp = write_page_ref[b]
+                    pltpu.make_async_copy(
+                        k_scratch.at[slot, j], k_out.at[lyr, wp],
+                        wsem.at[0]).start()
+                    pltpu.make_async_copy(
+                        v_scratch.at[slot, j], v_out.at[lyr, wp],
+                        wsem.at[1]).start()
+                    pltpu.make_async_copy(
+                        k_scratch.at[slot, j], k_out.at[lyr, wp],
+                        wsem.at[0]).wait()
+                    pltpu.make_async_copy(
+                        v_scratch.at[slot, j], v_out.at[lyr, wp],
+                        wsem.at[1]).wait()
+
+        S = ppc * page_size
+        GD = acc_ref.shape[1]
+        q = q_ref[0]                                   # (H, GD)
+        k = k_scratch[slot].reshape(S, GD)
+        v = v_scratch[slot].reshape(S, GD)
+        dims = (((1,), (1,)), ((), ()))
+        logits = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32), dims,
+            preferred_element_type=jnp.float32) * scale
+        pos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+        live = pos < seq_len
+        logits = jnp.where(live, logits, NEG_INF)
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(c == num_chunks - 1)
+    def _():
+        out_ref[0] = (acc_ref[...] / l_ref[...]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("pages_per_chunk", "interpret"))
+def fused_decode_attention_pallas(
+    q: jnp.ndarray,             # (B, H, D)
+    k_new: jnp.ndarray,         # (B, H_kv, D) — current tokens' K
+    v_new: jnp.ndarray,
+    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv, D)
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # (B, max_pages) int32
+    seq_lens: jnp.ndarray,      # (B,) int32 (pos+1, incl. current)
+    write_page: jnp.ndarray,    # (B,) int32 — pool page id to write
+    layer: jnp.ndarray | int = 0,
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+):
+    """Fused decode step: write the current tokens' KV into the pool
+    (in place, aliased) AND return attention over the updated history.
+    Returns (attn (B, H, D), k_pool, v_pool).
+
+    ``write_page`` must equal ``block_tables[b, (seq_lens[b]-1)//ps]``
+    for live rows (the engine's invariant) or 0 for inactive rows.
+    All live rows' write pages must be distinct.
+    """
+    B, H, D = q.shape
+    L, P, page_size, Hkv, _ = k_pool.shape
+    max_pages = block_tables.shape[1]
+    n_rep = H // Hkv
+    GD = Hkv * D
+    if GD % 128:
+        raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
+    ppc = min(pages_per_chunk, max_pages)
+    while max_pages % ppc:
+        ppc -= 1
+    num_chunks = max_pages // ppc
+
+    eye = jnp.eye(Hkv, dtype=q.dtype)
+    q_bd = jnp.einsum("bgrd,gh->bgrhd", q.reshape(B, Hkv, n_rep, D),
+                      eye).reshape(B, H, GD)
+    n_pad = -(-B // 8) * 8
+    kn = jnp.pad(k_new.reshape(B, GD), ((0, n_pad - B), (0, 0))
+                 ).astype(k_pool.dtype)
+    vn = jnp.pad(v_new.reshape(B, GD), ((0, n_pad - B), (0, 0))
+                 ).astype(v_pool.dtype)
+
+    kernel = functools.partial(
+        _fused_kernel, pages_per_chunk=ppc, page_size=page_size,
+        num_chunks=num_chunks, scale=D ** -0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, num_chunks),
+        in_specs=[
+            pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((n_pad, GD), lambda b, c, *_: (0, 0)),
+            pl.BlockSpec((n_pad, GD), lambda b, c, *_: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, GD), jnp.float32),
+            pltpu.VMEM((2, ppc, page_size, GD), k_pool.dtype),
+            pltpu.VMEM((2, ppc, page_size, GD), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, ppc)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kf = k_pool.reshape(L, P, page_size, GD)
+    vf = v_pool.reshape(L, P, page_size, GD)
+    # Operands: 4 scalar-prefetch, then q_bd, kn, vn, kf, vf → pool
+    # operands 7/8 alias outputs 1/2.
+    out, k_out, v_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, H, GD), q.dtype),
+                   jax.ShapeDtypeStruct(kf.shape, kf.dtype),
+                   jax.ShapeDtypeStruct(vf.shape, vf.dtype)],
+        input_output_aliases={7: 1, 8: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      write_page.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
+      q_bd, kn, vn, kf, vf)
+    out5 = out.reshape(B, Hkv, n_rep, Hkv, D)
+    attn = jnp.einsum("bgrhd,gh->bgrd", out5,
+                      jnp.eye(Hkv, dtype=out.dtype)).reshape(B, H, D)
+    return attn.astype(q.dtype), (k_out.reshape(L, P, page_size, Hkv, D),
+                                  v_out.reshape(L, P, page_size, Hkv, D))

@@ -229,6 +229,44 @@ class TestKvWriteKernels:
             np.asarray(ref_cache["k"][:, 1:5]), atol=3e-2, rtol=3e-2)
 
 
+class TestFusedDecode:
+    def test_matches_unfused(self, monkeypatch):
+        """Fused write+attention == scatter-write + pooled attention,
+        including page-boundary positions and the pool update."""
+        from llmq_tpu.ops.pallas.fused_decode import (
+            fused_decode_attention_pallas)
+        from llmq_tpu.ops.attention import (paged_decode_attention_pooled,
+                                            paged_kv_write)
+        monkeypatch.setenv("LLMQ_PALLAS", "0")   # pure reference path
+        rng = np.random.default_rng(3)
+        L, P, ps, Hkv, D, H, B = 2, 24, 8, 2, 64, 4, 3
+        mp = 6
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+                             jnp.float32)
+        bt = jnp.asarray(
+            rng.permutation(np.arange(1, P))[:B * mp].reshape(B, mp),
+            jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        kn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+        positions = jnp.asarray([0, 15, 37], jnp.int32)  # page edges
+        seq_lens = positions + 1
+        page_of = bt[jnp.arange(B), positions // ps]
+        slot_of = positions % ps
+        rk, rv = paged_kv_write(k_pool, v_pool, kn, vn, page_of,
+                                slot_of, 1)
+        ref = paged_decode_attention_pooled(q, rk, rv, bt, seq_lens, 1)
+        attn, (ok, ov) = fused_decode_attention_pallas(
+            q, kn, vn, k_pool, v_pool, bt, seq_lens, page_of, 1,
+            pages_per_chunk=2, interpret=True)
+        np.testing.assert_allclose(np.asarray(attn), np.asarray(ref),
+                                   atol=3e-2, rtol=3e-2)
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(rk))
+        np.testing.assert_array_equal(np.asarray(ov), np.asarray(rv))
+
+
 class TestPrefillAttentionKernel:
     @pytest.mark.parametrize("start", [0, 24])
     def test_matches_blockwise(self, start):
