@@ -10,16 +10,21 @@ processing with 0.5-3 s sleeps, cmd/queue-manager/main.go:139-153).
 Extra fields:
 - ``tiers``: per-priority-tier p50/p99 end-to-end latency under a 4-tier
   Poisson load against the echo engine (BASELINE config #1).
-- ``tpu``: single-chip decode tokens/s, per-step ms, prefill tokens/s and
-  MFU with a real paged-KV Llama model (BASELINE config #2) when an
-  accelerator is present.
+- ``tpu``: single-chip decode tokens/s, per-step ms, prefill tokens/s
+  (serialized + pipelined) and MFU with a real paged-KV Llama model
+  (BASELINE config #2) when an accelerator is present.
+- ``tpu_tiers``: per-tier p50/p99 for a small 4-tier Poisson load
+  against the REAL model on the chip, with priority admission and
+  preemption live (BASELINE config #4).
 
 All human-readable progress goes to stderr; stdout carries exactly one
 JSON line.
 
 Env knobs: LLMQ_BENCH_QUEUE_MSGS, LLMQ_BENCH_POISSON_RATE,
 LLMQ_BENCH_POISSON_SECS, LLMQ_BENCH_MODEL, LLMQ_BENCH_BATCH,
-LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SKIP_TPU.
+LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SEQ, LLMQ_BENCH_CHUNK,
+LLMQ_BENCH_TPU_POISSON_RATE, LLMQ_BENCH_TPU_POISSON_SECS,
+LLMQ_BENCH_SKIP_TPU.
 """
 
 from __future__ import annotations
@@ -57,6 +62,36 @@ def pctl(xs: List[float], q: float) -> float:
     xs = sorted(xs)
     i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
     return xs[i]
+
+
+# Tier mix shared by both Poisson benches (echo and on-chip).
+TIER_MIX = [(Priority.REALTIME, 0.10), (Priority.HIGH, 0.20),
+            (Priority.NORMAL, 0.40), (Priority.LOW, 0.30)]
+
+
+def sample_tier(rng: random.Random) -> "Priority":
+    r = rng.random()
+    acc = 0.0
+    for p, w in TIER_MIX:
+        acc += w
+        if r < acc:
+            return p
+    return Priority.LOW
+
+
+def tier_report(lat: Dict[str, List[float]], out: Dict,
+                label: str) -> None:
+    """Fold per-tier p50/p99 into ``out`` and log them."""
+    for p in TIERS:
+        xs = lat[p.tier_name]
+        out[p.tier_name] = {
+            "n": len(xs),
+            "p50_ms": round(pctl(xs, 0.50) * 1e3, 2),
+            "p99_ms": round(pctl(xs, 0.99) * 1e3, 2),
+        }
+        log(f"[{label}] {p.tier_name:9s} n={len(xs):5d} "
+            f"p50={out[p.tier_name]['p50_ms']:9.2f}ms "
+            f"p99={out[p.tier_name]['p99_ms']:9.2f}ms")
 
 
 # -- 1. queue-plane saturation throughput -------------------------------------
@@ -151,8 +186,6 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
     for w in workers:
         w.start()
 
-    mix = [(Priority.REALTIME, 0.10), (Priority.HIGH, 0.20),
-           (Priority.NORMAL, 0.40), (Priority.LOW, 0.30)]
     rng = random.Random(42)
     n_sent = 0
     log(f"[poisson] {rate_per_s:.0f} req/s for {duration_s:.0f}s "
@@ -167,14 +200,7 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
             time.sleep(min(0.001, next_arrival - now))
             continue
         next_arrival += rng.expovariate(rate_per_s)
-        r = rng.random()
-        acc = 0.0
-        prio = Priority.LOW
-        for p, w_ in mix:
-            acc += w_
-            if r < acc:
-                prio = p
-                break
+        prio = sample_tier(rng)
         mid = f"p{n_sent}"
         msg = Message(id=mid, content=f"req {n_sent % 100}", user_id="bench",
                       priority=prio, timeout=30.0)
@@ -198,16 +224,7 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
     out: Dict = {"offered_rate": rate_per_s,
                  "achieved_rate": round(total_done / elapsed, 1),
                  "sent": n_sent, "completed": total_done}
-    for p in TIERS:
-        xs = lat[p.tier_name]
-        out[p.tier_name] = {
-            "n": len(xs),
-            "p50_ms": round(pctl(xs, 0.50) * 1e3, 2),
-            "p99_ms": round(pctl(xs, 0.99) * 1e3, 2),
-        }
-        log(f"[poisson] {p.tier_name:9s} n={len(xs):5d} "
-            f"p50={out[p.tier_name]['p50_ms']:8.2f}ms "
-            f"p99={out[p.tier_name]['p99_ms']:8.2f}ms")
+    tier_report(lat, out, "poisson")
     return out
 
 
@@ -342,6 +359,77 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     }
 
 
+# -- 4. 4-tier Poisson against the REAL model on TPU (BASELINE #4) ------------
+
+def bench_poisson_tpu(model_name: str, rate_per_s: float,
+                      duration_s: float) -> Optional[Dict]:
+    """Open-loop Poisson arrivals into the jax engine on the real chip:
+    per-tier end-to-end latency with strict-priority admission and
+    step-boundary preemption live. Smaller scale than the echo run —
+    the point is SLA SHAPE (realtime p99 bounded while low tier absorbs
+    the queueing) on real decode steps, not peak throughput."""
+    import jax
+
+    if jax.default_backend() == "cpu" and not os.environ.get(
+            "LLMQ_BENCH_FORCE_CPU"):
+        log("[poisson-tpu] no accelerator; skipping")
+        return None
+
+    from llmq_tpu.engine.engine import GenRequest, InferenceEngine
+    from llmq_tpu.engine.executor import JaxExecutor
+    from llmq_tpu.engine.tokenizer import ByteTokenizer
+    from llmq_tpu.models.llama import get_config, init_params
+
+    tok = ByteTokenizer()
+    cfg = get_config(model_name, max_seq_len=512)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    slots = 8
+    ex = JaxExecutor(cfg, params, batch_size=slots, page_size=16,
+                     num_pages=slots * 32 + 1, chunk_size=8,
+                     prefill_buckets=[64], eos_id=tok.eos_id)
+    log(f"[poisson-tpu] warmup {cfg.name} ({slots} slots) ...")
+    ex.warmup()
+    engine = InferenceEngine(ex, tok, enable_metrics=False,
+                             max_decode_steps=32)
+    engine.start()
+
+    rng = random.Random(7)
+    lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
+    handles = []
+    log(f"[poisson-tpu] {rate_per_s:.1f} req/s for {duration_s:.0f}s ...")
+    t_start = time.perf_counter()
+    next_arrival = t_start
+    n_sent = 0
+    while time.perf_counter() - t_start < duration_s:
+        now = time.perf_counter()
+        if now < next_arrival:
+            time.sleep(min(0.002, next_arrival - now))
+            continue
+        next_arrival += rng.expovariate(rate_per_s)
+        h = engine.submit(GenRequest(
+            id=f"pt{n_sent}", prompt=f"load test request {n_sent % 50}",
+            priority=sample_tier(rng), max_new_tokens=24))
+        handles.append(h)
+        n_sent += 1
+    # One SHARED drain deadline: a wedged engine must bound the bench,
+    # not stall it per-handle.
+    deadline = time.perf_counter() + 90.0
+    for h in handles:
+        if not h.wait(max(0.0, deadline - time.perf_counter())):
+            break
+    engine.stop()
+    completed = 0
+    for h in handles:
+        if h.done and h.result.finish_reason in ("eos", "length"):
+            completed += 1
+            lat[h.request.priority.tier_name].append(h.latency)
+    out: Dict = {"offered_rate": rate_per_s, "sent": n_sent,
+                 "completed": completed,
+                 "decode_steps": engine.steps}
+    tier_report(lat, out, "poisson-tpu")
+    return out
+
+
 # -- main ---------------------------------------------------------------------
 
 def main() -> None:
@@ -355,11 +443,19 @@ def main() -> None:
     qres = bench_queue_throughput(n_msgs)
     tiers = bench_poisson_echo(rate, secs)
     tpu = None
+    tpu_tiers = None
     if not os.environ.get("LLMQ_BENCH_SKIP_TPU"):
         try:
             tpu = bench_tpu_decode(model, batch, steps)
         except Exception as e:  # noqa: BLE001
             log(f"[tpu] decode bench failed: {type(e).__name__}: {e}")
+        try:
+            tpu_tiers = bench_poisson_tpu(
+                model,
+                float(os.environ.get("LLMQ_BENCH_TPU_POISSON_RATE", "3")),
+                float(os.environ.get("LLMQ_BENCH_TPU_POISSON_SECS", "20")))
+        except Exception as e:  # noqa: BLE001
+            log(f"[poisson-tpu] failed: {type(e).__name__}: {e}")
 
     result = {
         "metric": "queue_throughput",
@@ -369,6 +465,7 @@ def main() -> None:
         "queue": qres,
         "tiers": tiers,
         "tpu": tpu,
+        "tpu_tiers": tpu_tiers,
     }
     print(json.dumps(result), flush=True)
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -101,6 +102,8 @@ class GenHandle:
     def __init__(self, request: GenRequest) -> None:
         self.request = request
         self.result: Optional[GenResult] = None
+        self.submitted_at = time.perf_counter()
+        self.finished_at: Optional[float] = None   # per-request latency
         self._done = threading.Event()
         self._cancelled = threading.Event()
 
@@ -120,7 +123,15 @@ class GenHandle:
 
     def _finish(self, result: GenResult) -> None:
         self.result = result
+        self.finished_at = time.perf_counter()
         self._done.set()
+
+    @property
+    def latency(self) -> Optional[float]:
+        """Submit → finish seconds, once done."""
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
 
 
 class _Sequence:
