@@ -140,7 +140,8 @@ class _Sequence:
     __slots__ = ("req", "handle", "prompt_ids", "generated", "pages",
                  "block_table", "pos", "cached_len", "last_token", "slot",
                  "prefilled", "order", "adopted", "prefill_ids",
-                 "prefill_start", "carry", "written_ids", "rebuild")
+                 "prefill_start", "carry", "written_ids", "rebuild",
+                 "todo_ids", "todo_pos", "todo_rebuild", "todo_resume")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -166,6 +167,12 @@ class _Sequence:
         #: including adopted conversation history, by re-prefilling.
         self.written_ids: List[int] = []
         self.rebuild = False      # pages were released; re-prefill written_ids
+        #: Incremental-prefill state: tokens not yet run, next write
+        #: position, and the completion context snapshotted at admission.
+        self.todo_ids: List[int] = []
+        self.todo_pos = 0
+        self.todo_rebuild = False
+        self.todo_resume: Optional[int] = None
 
     def sort_key(self):
         return (int(self.req.priority), self.order)
@@ -362,8 +369,9 @@ class InferenceEngine:
         self._ingest()
         self._expire_pins()
         admitted = self._admit()
+        prefilled = self._advance_prefill()
         stepped = self._decode_once()
-        return admitted or stepped
+        return admitted or prefilled or stepped
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         for _ in range(max_steps):
@@ -392,10 +400,21 @@ class InferenceEngine:
         return None
 
     def _least_urgent_active(
-            self, exclude: Optional[_Sequence] = None) -> Optional[_Sequence]:
+            self, exclude: Optional[_Sequence] = None, *,
+            include_prefilling: bool = False) -> Optional[_Sequence]:
+        """Least-urgent slot holder. Mid-prefill sequences are excluded
+        from SLOT preemption (their partial prefill can't resume in
+        place — slot-only preemption would replay chunks), but they ARE
+        valid victims for page-RELEASE shedding (include_prefilling):
+        release folds their un-run remainder into ``written_ids`` and
+        restarts via the rebuild path, so a low-tier long prompt can
+        never hold the pool against a realtime sequence (priority
+        inversion)."""
         worst: Optional[_Sequence] = None
         for s in self._slots:
             if s is None or s is exclude:
+                continue
+            if not s.prefilled and not include_prefilling:
                 continue
             if worst is None or s.sort_key() > worst.sort_key():
                 worst = s
@@ -463,7 +482,13 @@ class InferenceEngine:
         seq.block_table[:] = 0
         seq.pos = 0
         seq.cached_len = 0
-        if seq.prefilled:
+        if seq.todo_ids:
+            # Mid-prefill victim: fold the un-run remainder into
+            # written_ids so the rebuild re-prefills the COMPLETE
+            # context (adopted history + chunks written + remainder).
+            seq.written_ids = seq.written_ids + seq.todo_ids
+            seq.todo_ids = []
+        if seq.prefilled or seq.written_ids:
             seq.rebuild = True
         seq.prefilled = False
 
@@ -514,7 +539,8 @@ class InferenceEngine:
                 continue
             if self._reclaim_pending_pages(requester):
                 continue
-            victim = self._least_urgent_active(exclude=requester)
+            victim = self._least_urgent_active(exclude=requester,
+                                               include_prefilling=True)
             if (victim is not None and self.preemption_enabled
                     and victim.sort_key() > requester.sort_key()):
                 self._preempt(victim, release_pages=True)
@@ -579,8 +605,17 @@ class InferenceEngine:
             if start_pos + len(ids) + 1 > capacity and start_pos > 0:
                 # The cached prefix + new tokens exceed the block table.
                 # Fold the prefix into a from-scratch rebuild so the
-                # window can slide (written_ids holds its token ids).
-                ids = seq.written_ids + ids
+                # window can slide. The fold moves the history tokens
+                # into ``carry`` (not just this attempt's local ``ids``):
+                # if the page allocation below fails and the sequence
+                # retries admission later, the retry recomputes the SAME
+                # folded stream — otherwise the adopted history would be
+                # silently dropped.
+                seq.carry = seq.written_ids + seq.carry
+                seq.written_ids = []
+                ids = seq.carry + seq.prompt_ids
+                if seq.generated:
+                    ids = ids + seq.generated[:-1]
                 if seq.pages:
                     self.allocator.free(seq.pages)
                     seq.pages = []
@@ -611,49 +646,75 @@ class InferenceEngine:
                 seq.block_table[have:have + need] = pages
                 seq.pages.extend(pages)
 
-            was_rebuild = seq.rebuild
-            with self._prof.span("engine.prefill", tokens=len(ids)):
-                first = self.executor.prefill(ids, start_pos,
-                                              seq.block_table,
-                                              req.temperature, slot)
-            seq.pos = start_pos + len(ids)
-            if was_rebuild or start_pos == 0:
-                seq.written_ids = list(ids)
-            else:
-                seq.written_ids.extend(ids)
+            # Incremental prefill: the sequence takes its slot NOW but
+            # runs at most one prefill bucket per engine step
+            # (_advance_prefill), so a long prompt can't stall every
+            # decoding sequence for its whole duration — the classic
+            # continuous-batching prefill stall, bounded here to one
+            # bucket per step.
+            seq.todo_ids = ids
+            seq.todo_pos = start_pos
+            seq.todo_rebuild = seq.rebuild
+            seq.todo_resume = resume_last
             seq.rebuild = False
-            if was_rebuild and seq.generated:
-                # KV is rebuilt, but per-slot-state executors (the echo
-                # mock) must see the ORIGINAL prefill stream, not the
-                # history+output mix we just replayed.
-                self.executor.resume(slot, seq.prefill_ids,
-                                     seq.prefill_start)
-            else:
+            if seq.todo_rebuild or start_pos == 0:
+                seq.written_ids = []
+            if not (seq.todo_rebuild and seq.generated):
                 seq.prefill_ids = ids
                 seq.prefill_start = start_pos
-            seq.prefilled = True
             seq.slot = slot
-            self._slots[slot] = seq
-            if resume_last is not None:
-                seq.last_token = resume_last
-                return True
-            if first == self.spec.eos_id:
-                self._finish_active(seq, "eos")
-                return True
-            seq.generated.append(first)
-            seq.last_token = first
-            if self._metrics:
-                self._metrics.generated_tokens.labels(
-                    self.name, req.priority.tier_name).inc()
-            limit = req.max_new_tokens or self.max_decode_steps
-            if len(seq.generated) >= limit:
-                self._finish_active(seq, "length")
+            self._slots[slot] = seq        # slot held; prefilled=False
             return True
         # Resuming a slot-only preemption: KV intact, just take the slot
         # (per-slot-state executors re-register their context).
         self.executor.resume(slot, seq.prefill_ids, seq.prefill_start)
         seq.slot = slot
         self._slots[slot] = seq
+        return True
+
+    def _advance_prefill(self) -> bool:
+        """Run ONE prefill bucket for the most urgent mid-prefill
+        sequence; completes its admission when the last chunk lands.
+        Returns True if any prefill work ran."""
+        cands = [s for s in self._slots
+                 if s is not None and not s.prefilled]
+        # Reap EVERY cancelled candidate — a cancelled low-tier prompt
+        # must not hold its slot and pages just because more urgent
+        # prefill work keeps winning the head-of-line pick.
+        reaped = False
+        for s in list(cands):
+            if s.handle.cancelled:
+                self._finish_active(s, "cancelled")
+                cands.remove(s)
+                reaped = True
+        if not cands:
+            return reaped
+        seq = min(cands, key=lambda s: s.sort_key())
+        buckets = getattr(self.executor, "prefill_buckets", None)
+        chunk_len = buckets[-1] if buckets else len(seq.todo_ids)
+        chunk = seq.todo_ids[:chunk_len]
+        seq.todo_ids = seq.todo_ids[chunk_len:]
+        with self._prof.span("engine.prefill", tokens=len(chunk)):
+            first = self.executor.prefill(chunk, seq.todo_pos,
+                                          seq.block_table,
+                                          seq.req.temperature, seq.slot)
+        seq.todo_pos += len(chunk)
+        seq.pos = seq.todo_pos
+        seq.written_ids.extend(chunk)
+        if seq.todo_ids:
+            return True                     # more buckets next step
+        # Final chunk: the admission-completion logic.
+        if seq.todo_rebuild and seq.generated:
+            # KV is rebuilt, but per-slot-state executors (the echo
+            # mock) must see the ORIGINAL prefill stream, not the
+            # history+output mix we just replayed.
+            self.executor.resume(seq.slot, seq.prefill_ids,
+                                 seq.prefill_start)
+        seq.prefilled = True
+        if seq.todo_resume is not None:
+            seq.last_token = seq.todo_resume
+            return True
+        self._commit_token(seq, first)   # EOS / append / metrics / limit
         return True
 
     def _budget_for(self, seq: _Sequence, chunk: int) -> int:
@@ -682,7 +743,8 @@ class InferenceEngine:
     def _decode_once(self) -> bool:
         B = self.spec.batch_size
         chunk = max(1, getattr(self.executor, "chunk_size", 1))
-        active = [s for s in self._slots if s is not None]
+        active = [s for s in self._slots
+                  if s is not None and s.prefilled]
         if not active:
             self._set_gauges()
             return False
@@ -704,7 +766,8 @@ class InferenceEngine:
                     self._preempt(seq, release_pages=True)
                 continue
             budgets_by_order[seq.order] = budget
-        active = [s for s in self._slots if s is not None]
+        active = [s for s in self._slots
+                  if s is not None and s.prefilled]
         if not active:
             self._set_gauges()
             return False
