@@ -101,6 +101,11 @@ class QueueConfig:
     dead_letter_enabled: bool = True
     dead_letter_max_size: int = 1000
     stale_message_age: float = 3600.0  # cleanupStaleMessages stub (queue_manager.go:549-553), real here
+    #: Directory for per-manager write-ahead logs; "" disables. The
+    #: reference's queues are memory-only — every pending message dies
+    #: with the process (SURVEY §5). With a wal_dir, pending and
+    #: in-flight messages survive restarts (at-least-once redelivery).
+    wal_dir: str = ""
 
 
 @dataclass

@@ -103,9 +103,14 @@ class QueueFactory:
             entry = self._entries.get(name)
             if entry is not None:
                 return entry.manager
+            wal_path = None
+            if self.config.queue.wal_dir:
+                import os
+                wal_path = os.path.join(self.config.queue.wal_dir,
+                                        f"{name}.wal")
             manager = QueueManager(
                 name, config=self.config, clock=self._clock, backend=self._backend,
-                enable_metrics=enable_metrics)
+                enable_metrics=enable_metrics, wal_path=wal_path)
             dlq: Optional[DeadLetterQueue] = None
             if self.config.queue.dead_letter_enabled or qtype == QueueType.DEAD_LETTER:
                 dlq = DeadLetterQueue(

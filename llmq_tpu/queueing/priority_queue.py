@@ -412,6 +412,19 @@ class MultiLevelQueue:
         msg.error = "removed by admin"
         return msg
 
+    def snapshot(self, name: str) -> List[Message]:
+        """Live pending messages of a queue in arrival order (tombstoned
+        entries excluded) — WAL compaction uses this to rewrite the
+        journal as the exact current live set."""
+        if not self.has_queue(name):
+            raise QueueNotFoundError(name)
+        with self._mu:
+            rows = [(ts, h, msg) for h, (qn, msg, ts) in
+                    self._messages.items()
+                    if qn == name and h not in self._tombstones]
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return [msg for _, _, msg in rows]
+
     # -- stale cleanup (real version of queue_manager.go:549-553) ------------
 
     def expire_older_than(self, name: str, max_age: float) -> List[Message]:

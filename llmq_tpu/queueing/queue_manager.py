@@ -76,6 +76,7 @@ class QueueManager:
         backend: str = "auto",
         enable_metrics: Optional[bool] = None,
         scale_callback: Optional[Callable[[ScaleSignal], None]] = None,
+        wal_path: Optional[str] = None,
     ) -> None:
         self.name = name
         self.config: Config = config or default_config()
@@ -102,6 +103,48 @@ class QueueManager:
         for lvl in self.qconfig.levels:
             self.queue.create_queue(Priority(lvl.priority).tier_name,
                                     capacity=self.qconfig.max_queue_size)
+
+        # Optional durability (the reference loses every pending message
+        # on restart — SURVEY §5): journal mutations, replay on startup.
+        self._wal = None
+        #: id → (queue, Message) for popped/parked-but-unfinished
+        #: messages: they are part of the WAL's live set (redelivery on
+        #: restart) but absent from the queue snapshot, so compaction
+        #: needs them tracked here.
+        self._wal_inflight: Dict[str, tuple] = {}
+        if wal_path:
+            from llmq_tpu.queueing.wal import QueueWAL
+            restored = QueueWAL.replay(wal_path)
+            self._wal = QueueWAL(wal_path)
+            if restored:
+                kept: List[tuple] = []
+                dropped = 0
+                for qname, msg in restored:
+                    if not self.queue.has_queue(qname):
+                        self.create_queue(qname)
+                    try:
+                        self.queue.push(qname, msg)
+                    except Exception:  # noqa: BLE001 — e.g. capacity
+                        # In-flight redelivery can exceed a full queue's
+                        # capacity; dropping the overflow (with a loud
+                        # log) beats never starting.
+                        dropped += 1
+                        continue
+                    kept.append((qname, msg))
+                    # Mirror push_message's bookkeeping for the
+                    # restored entries (gauge + routing map).
+                    with self._inflight_mu:
+                        self._inflight[msg.id] = qname
+                    if self._metrics:
+                        self._metrics.pending.labels(
+                            self.name, qname, msg.priority.tier_name).inc()
+                # Compact so the journal holds exactly what was kept.
+                self._wal.rewrite(kept)
+                if dropped:
+                    log.error("wal: DROPPED %d restored messages over "
+                              "queue capacity (%s)", dropped, self.name)
+                log.info("wal: restored %d pending messages into %s",
+                         len(kept), self.name)
 
     # -- queue management ----------------------------------------------------
 
@@ -145,11 +188,20 @@ class QueueManager:
         """Apply rules, route, push. Returns the queue it landed in."""
         self._apply_rules(message)
         qname = queue_name or self.route_for(message)
+        if self._wal:
+            # Journal BEFORE the push: a pop/complete from a concurrent
+            # worker can only happen after the push succeeds, so records
+            # can never appear out of order in the journal.
+            self._wal.append("push", qname, message.id, message)
         try:
             self.queue.push(qname, message)
         except Exception:
+            if self._wal:
+                self._wal.append("remove", qname, message.id)
             self._op_metric("push", "error")
             raise
+        if self._wal:
+            self._wal_inflight.pop(message.id, None)  # delayed re-push
         with self._inflight_mu:
             self._inflight[message.id] = qname
         if self._metrics:
@@ -164,6 +216,9 @@ class QueueManager:
 
     def pop_message(self, queue_name: str) -> Message:
         msg = self.queue.pop(queue_name)
+        if self._wal:
+            self._wal.append("pop", queue_name, msg.id)
+            self._wal_inflight[msg.id] = (queue_name, msg)
         if self._metrics:
             lbl = (self.name, queue_name, msg.priority.tier_name)
             self._metrics.pending.labels(*lbl).dec()
@@ -185,6 +240,9 @@ class QueueManager:
             m = self.queue.try_pop(queue_name)
             if m is None:
                 break
+            if self._wal:
+                self._wal.append("pop", queue_name, m.id)
+                self._wal_inflight[m.id] = (queue_name, m)
             if self._metrics:
                 lbl = (self.name, queue_name, m.priority.tier_name)
                 self._metrics.pending.labels(*lbl).dec()
@@ -211,6 +269,9 @@ class QueueManager:
                          queue_name: Optional[str] = None) -> None:
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
         self.queue.complete_message(qname, message, process_time)
+        if self._wal:
+            self._wal.append("complete", qname, message.id)
+            self._wal_inflight.pop(message.id, None)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -222,6 +283,9 @@ class QueueManager:
                      queue_name: Optional[str] = None) -> None:
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
         self.queue.fail_message(qname, message, process_time)
+        if self._wal:
+            self._wal.append("fail", qname, message.id)
+            self._wal_inflight.pop(message.id, None)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -233,6 +297,9 @@ class QueueManager:
         """Retry path: return a PROCESSING message to its queue."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
         self.queue.requeue(qname, message)
+        if self._wal:
+            self._wal.append("requeue", qname, message.id)
+            self._wal_inflight.pop(message.id, None)  # back in the queue
         with self._inflight_mu:
             self._inflight[message.id] = qname
         if self._metrics:
@@ -248,6 +315,8 @@ class QueueManager:
         queue after its retry backoff elapses."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
         self.queue.requeue_accounting_for(qname)
+        if self._wal:
+            self._wal.append("stash", qname, message.id)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -263,6 +332,9 @@ class QueueManager:
         for qname in names:
             msg = self.queue.remove_message(qname, message_id)
             if msg is not None:
+                if self._wal:
+                    self._wal.append("remove", qname, message_id)
+                    self._wal_inflight.pop(message_id, None)
                 with self._inflight_mu:
                     self._inflight.pop(message_id, None)
                 if self._metrics:
@@ -302,6 +374,8 @@ class QueueManager:
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=2.0)
             self._monitor_thread = None
+        if self._wal:
+            self._wal.close()
 
     def _monitor_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
@@ -325,12 +399,24 @@ class QueueManager:
                     # stats when the tombstone surfaces).
                     for msg in expired:
                         self._pop_inflight(msg.id)
+                        if self._wal:
+                            # Expired messages must not resurrect on
+                            # restart.
+                            self._wal.append("remove", qname, msg.id)
+                            self._wal_inflight.pop(msg.id, None)
                         if self._metrics:
                             lbl = (self.name, qname, msg.priority.tier_name)
                             self._metrics.pending.labels(*lbl).dec()
                             self._metrics.failed.labels(*lbl).inc()
                     log.warning("expired %d stale messages from %s/%s",
                                 len(expired), self.name, qname)
+        # Bound the journal: rewrite it as the current live set once
+        # dead records dominate (pending snapshot + unfinished pops).
+        if self._wal:
+            live = [(qname, m) for qname in self.queue_names()
+                    for m in self.queue.snapshot(qname)]
+            live.extend(self._wal_inflight.values())
+            self._wal.maybe_compact(live)
         # Threshold check (:521-546) with a real actuator callback.
         total = sum(s.pending_count for s in stats.values())
         signal: Optional[ScaleSignal] = None

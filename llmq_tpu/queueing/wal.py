@@ -1,0 +1,169 @@
+"""Write-ahead log for queue durability.
+
+The reference's queues are purely in-memory: **every pending message is
+lost on restart** (SURVEY.md §5 — its README claims Redis-backed
+queueing that is never implemented). This WAL makes the queue plane
+restart-safe without any external service: every queue mutation appends
+one JSON line, and on startup :func:`QueueWAL.replay` reconstructs the
+live set — pending messages re-enter their queues in original arrival
+order (priority + FIFO survive because ``Message.created_at`` rides
+along), and popped-but-never-completed messages are redelivered
+(at-least-once semantics, the same contract the worker's retry path
+already assumes).
+
+Ops: ``push`` (carries the full message), ``pop``, ``complete``,
+``fail``, ``remove`` (terminal), ``requeue``/``stash`` (message returns
+to the live set; ``stash`` marks a retry parked in the delayed queue —
+on replay it is redelivered immediately rather than re-arming the
+backoff timer, which only makes a retry earlier, never lost).
+
+Durability knob: the file is flushed on every append; fsync happens
+every ``fsync_every`` appends (default 64) and on close — a crash can
+lose at most the last fsync window, a restart never corrupts (partial
+trailing lines are skipped). Compaction rewrites the file with only the
+live set whenever the dead-record ratio grows past ``compact_ratio``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from llmq_tpu.core.types import Message
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("wal")
+
+_TERMINAL = ("complete", "fail", "remove")
+_LIVE_PENDING = "pending"
+_LIVE_INFLIGHT = "inflight"
+
+
+class QueueWAL:
+    """Append-only journal of queue mutations for one QueueManager."""
+
+    def __init__(self, path: str, *, fsync_every: int = 64,
+                 compact_ratio: float = 4.0) -> None:
+        self.path = path
+        self.fsync_every = max(1, fsync_every)
+        self.compact_ratio = compact_ratio
+        self._mu = threading.Lock()
+        self._since_sync = 0
+        self._records = 0
+        self._live = 0
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    # -- writing -------------------------------------------------------------
+
+    def append(self, op: str, queue: str, message_id: str,
+               message: Optional[Message] = None) -> None:
+        rec: Dict = {"op": op, "q": queue, "id": message_id}
+        if message is not None:
+            rec["msg"] = message.to_dict()
+        line = json.dumps(rec, default=str)
+        with self._mu:
+            self._f.write(line + "\n")
+            self._f.flush()
+            self._since_sync += 1
+            self._records += 1
+            if op == "push":
+                self._live += 1
+            elif op in _TERMINAL:
+                self._live = max(0, self._live - 1)
+            if self._since_sync >= self.fsync_every:
+                os.fsync(self._f.fileno())
+                self._since_sync = 0
+
+    def maybe_compact(self, live: List[Tuple[str, Message]]) -> bool:
+        """Rewrite the journal with only ``live`` (queue, message) pairs
+        when dead records dominate. Returns True if compacted."""
+        with self._mu:
+            if self._records < 1024 or (
+                    self._records <= self.compact_ratio * max(1, self._live)):
+                return False
+        self.rewrite(live)
+        return True
+
+    def rewrite(self, live: List[Tuple[str, Message]]) -> None:
+        """Atomically replace the journal with push records for ``live``."""
+        tmp = self.path + ".tmp"
+        with self._mu:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for qname, msg in live:
+                    f.write(json.dumps(
+                        {"op": "push", "q": qname, "id": msg.id,
+                         "msg": msg.to_dict()}, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._records = len(live)
+            self._live = len(live)
+            self._since_sync = 0
+        log.info("wal compacted to %d live records (%s)", len(live),
+                 self.path)
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except (OSError, ValueError):
+                pass
+            self._f.close()
+
+    # -- replay --------------------------------------------------------------
+
+    @staticmethod
+    def replay(path: str) -> List[Tuple[str, Message]]:
+        """Reconstruct the live set from a journal. Returns (queue,
+        message) pairs in original arrival order — pending AND
+        popped-but-unfinished messages (redelivery). Corrupt/partial
+        trailing lines are skipped."""
+        if not os.path.exists(path):
+            return []
+        state: Dict[str, Tuple[str, Dict, str]] = {}   # id → (q, msg, liveness)
+        order: List[str] = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("wal: skipping corrupt record in %s", path)
+                    continue
+                op = rec.get("op")
+                mid = rec.get("id")
+                if op == "push":
+                    if mid not in state:
+                        order.append(mid)
+                    state[mid] = (rec["q"], rec["msg"], _LIVE_PENDING)
+                elif mid in state:
+                    # Each op records the queue it acted on — honor it,
+                    # so an explicit requeue into a different queue
+                    # restores there, not at the original push target.
+                    q, msg, _ = state[mid]
+                    q = rec.get("q") or q
+                    if op == "pop":
+                        state[mid] = (q, msg, _LIVE_INFLIGHT)
+                    elif op in _TERMINAL:
+                        del state[mid]
+                    elif op in ("requeue", "stash"):
+                        state[mid] = (q, msg, _LIVE_PENDING)
+        out: List[Tuple[str, Message]] = []
+        for mid in order:
+            if mid in state:
+                q, msg_dict, _ = state[mid]
+                try:
+                    out.append((q, Message.from_dict(msg_dict)))
+                except (KeyError, TypeError, ValueError) as e:
+                    log.warning("wal: dropping unreadable message %s: %s",
+                                mid, e)
+        return out

@@ -1,0 +1,194 @@
+"""Queue durability (write-ahead log) — capability the reference lacks:
+its queues are memory-only and every pending message dies on restart
+(SURVEY §5; its README claims Redis queueing it never implements)."""
+
+import json
+import threading
+
+import pytest
+
+from llmq_tpu.core.config import default_config
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.queueing.queue_manager import QueueManager
+from llmq_tpu.queueing.wal import QueueWAL
+
+
+def mk(mid, prio=Priority.NORMAL, content="x"):
+    return Message(id=mid, content=content, user_id="u", priority=prio)
+
+
+class TestQueueWAL:
+    def test_pending_survive_restart_in_order(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        for i, p in enumerate([Priority.LOW, Priority.REALTIME,
+                               Priority.NORMAL, Priority.REALTIME]):
+            qm.push_message(mk(f"m{i}", p))
+        qm.stop()
+
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        assert qm2.total_pending() == 4
+        # Priority + FIFO order preserved across restart.
+        drained = qm2.drain_in_priority_order(10)
+        assert [m.id for m in drained] == ["m1", "m3", "m2", "m0"]
+        qm2.stop()
+
+    def test_completed_not_restored_inflight_redelivered(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        for i in range(4):
+            qm.push_message(mk(f"m{i}"))
+        a = qm.pop_message("normal")
+        b = qm.pop_message("normal")
+        qm.complete_message(a, 0.1)        # finished → gone
+        # b popped but never completed → crash → must redeliver
+        qm.stop()
+
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        restored = {m.id for m in qm2.drain_in_priority_order(10)}
+        assert a.id not in restored
+        assert restored == {"m1", "m2", "m3"}
+        qm2.stop()
+
+    def test_requeue_and_remove_ops(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        qm.push_message(mk("a"))
+        qm.push_message(mk("b"))
+        m = qm.pop_message("normal")
+        qm.requeue_message(m)              # back to pending
+        qm.remove_message("b")             # admin-removed → gone
+        qm.stop()
+
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        restored = [m.id for m in qm2.drain_in_priority_order(10)]
+        assert restored == ["a"]
+        qm2.stop()
+
+    def test_corrupt_trailing_line_skipped(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        qm.push_message(mk("good"))
+        qm.stop()
+        with open(wal, "a") as f:
+            f.write('{"op": "push", "q": "normal", "id": "torn", "ms')
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        assert [m.id for m in qm2.drain_in_priority_order(10)] == ["good"]
+        qm2.stop()
+
+    def test_restart_compacts_journal(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        for i in range(50):
+            qm.push_message(mk(f"m{i}"))
+        for m in qm.drain_in_priority_order(49):
+            qm.complete_message(m, 0.0)
+        qm.stop()
+        lines_before = sum(1 for _ in open(wal))
+        assert lines_before >= 148          # 50 push + 49 pop + 49 done
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        lines_after = sum(1 for _ in open(wal))
+        assert lines_after == 1             # only the live message
+        rec = json.loads(open(wal).readline())
+        assert rec["op"] == "push" and rec["id"] == "m49"
+        qm2.stop()
+
+    def test_message_fields_roundtrip(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        msg = mk("rich", Priority.HIGH, content="hello wörld")
+        msg.conversation_id = "c9"
+        msg.metadata["k"] = "v"
+        qm.push_message(msg)
+        qm.stop()
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        got = qm2.pop_message("high")
+        assert got.content == "hello wörld"
+        assert got.conversation_id == "c9"
+        assert got.metadata["k"] == "v"
+        qm2.stop()
+
+    def test_concurrent_appends_safe(self, tmp_path):
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+
+        def push_many(base):
+            for i in range(50):
+                qm.push_message(mk(f"{base}-{i}"))
+
+        ts = [threading.Thread(target=push_many, args=(b,))
+              for b in ("a", "b", "c")]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        qm.stop()
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal)
+        assert qm2.total_pending() == 150
+        qm2.stop()
+
+    def test_factory_wal_dir_wiring(self, tmp_path):
+        from llmq_tpu.queueing.factory import QueueFactory, QueueType
+        cfg = default_config()
+        cfg.queue.enable_metrics = False
+        cfg.queue.wal_dir = str(tmp_path)
+        fac = QueueFactory(cfg)
+        man = fac.create_queue_manager("std", QueueType.STANDARD)
+        man.push_message(mk("f1"))
+        fac.stop_all()
+        assert (tmp_path / "std.wal").exists()
+        fac2 = QueueFactory(cfg)
+        man2 = fac2.create_queue_manager("std", QueueType.STANDARD)
+        assert man2.total_pending() == 1
+        fac2.stop_all()
+
+    def test_monitor_compacts_running_journal(self, tmp_path):
+        """Long-running process: the monitor tick rewrites the journal
+        once dead records dominate (finding: compaction was restart-only
+        → unbounded growth)."""
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal)
+        qm.qconfig.stale_message_age = 0          # isolate compaction
+        for i in range(400):
+            qm.push_message(mk(f"m{i}"))
+        for m in qm.drain_in_priority_order(399):
+            qm.complete_message(m, 0.0)
+        assert sum(1 for _ in open(wal)) >= 1100
+        qm.run_monitor_once()
+        assert sum(1 for _ in open(wal)) == 1      # only m399 lives
+        qm.stop()
+
+    def test_stale_expiry_not_resurrected(self, tmp_path, fake_clock):
+        """Expired-stale messages must not come back on restart."""
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", enable_metrics=False, wal_path=wal,
+                          clock=fake_clock)
+        qm.qconfig.stale_message_age = 10.0
+        qm.push_message(mk("old"))
+        fake_clock.advance(100.0)
+        qm.push_message(mk("fresh"))
+        qm.run_monitor_once()                     # expires "old"
+        qm.stop()
+        qm2 = QueueManager("m", enable_metrics=False, wal_path=wal,
+                           clock=fake_clock)
+        assert [m.id for m in qm2.drain_in_priority_order(10)] == ["fresh"]
+        qm2.stop()
+
+    def test_restore_overflow_drops_not_crashes(self, tmp_path):
+        """More live WAL records than queue capacity must not prevent
+        startup — overflow drops loudly, service comes up."""
+        cfg = default_config()
+        cfg.queue.max_queue_size = 5
+        wal = str(tmp_path / "q.wal")
+        qm = QueueManager("m", config=cfg, enable_metrics=False,
+                          wal_path=wal)
+        for i in range(5):
+            qm.push_message(mk(f"m{i}"))
+        # Two popped-but-unfinished on top of a full queue → 7 live.
+        a = qm.pop_message("normal")
+        b = qm.pop_message("normal")
+        qm.push_message(mk("m5"))
+        qm.push_message(mk("m6"))
+        qm.stop()
+        qm2 = QueueManager("m", config=cfg, enable_metrics=False,
+                           wal_path=wal)
+        assert qm2.total_pending() == 5            # capacity, no crash
+        qm2.stop()
