@@ -166,7 +166,36 @@ def _load(args) -> Config:
         cfg.executor.backend = args.backend
     configure_logging(cfg.logging.level, cfg.logging.format,
                       cfg.logging.output)
+    _maybe_join_cluster()
     return cfg
+
+
+def _maybe_join_cluster() -> None:
+    """Multi-host bring-up from env (docs/deployment.md): when
+    LLMQ_COORDINATOR is set, every entrypoint joins the jax.distributed
+    cluster BEFORE any backend work — a 70B TP deployment spans hosts
+    as ONE pjit program, so the rendezvous must precede engine build.
+    Fails fast on a broken rendezvous (distributed_init propagates)."""
+    import os
+
+    coordinator = os.environ.get("LLMQ_COORDINATOR")
+    if not coordinator:
+        return
+    missing = [k for k in ("LLMQ_NUM_PROCESSES", "LLMQ_PROCESS_ID")
+               if k not in os.environ]
+    if missing:
+        raise SystemExit(
+            f"LLMQ_COORDINATOR is set but {', '.join(missing)} "
+            "is not — multi-host bring-up needs all three "
+            "(see docs/deployment.md)")
+    from llmq_tpu.parallel.mesh import distributed_init
+
+    distributed_init(
+        coordinator=coordinator,
+        num_processes=int(os.environ["LLMQ_NUM_PROCESSES"]),
+        process_id=int(os.environ["LLMQ_PROCESS_ID"]),
+        initialization_timeout=int(
+            os.environ.get("LLMQ_CLUSTER_TIMEOUT", "300")))
 
 
 def cmd_serve(args) -> int:

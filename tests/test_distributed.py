@@ -49,6 +49,12 @@ print(f"proc {{jax.process_index()}} OK", flush=True)
 """
 
 
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _clean_env():
     """Child env with a guaranteed-CPU jax: some dev images pre-import
     jax with a device plugin via a PYTHONPATH site hook BEFORE the
@@ -65,10 +71,7 @@ def _clean_env():
 @pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
                     reason="multi-process test disabled")
 def test_two_process_rendezvous_and_collective(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    coord = f"127.0.0.1:{port}"
+    coord = f"127.0.0.1:{_free_port()}"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     procs = []
@@ -120,3 +123,57 @@ def test_bad_coordinator_fails_fast():
         assert ("DEADLINE_EXCEEDED" in out
                 or "CoordinationService" in out
                 or "distributed service" in out), out
+
+
+@pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+def test_serve_entrypoints_join_cluster(tmp_path):
+    """Two `python -m llmq_tpu gateway` processes with LLMQ_COORDINATOR
+    env rendezvous into one jax.distributed cluster and both serve
+    HTTP — the multi-host deployment path of docs/deployment.md."""
+    coord_port = _free_port()
+    ports = [_free_port() for _ in range(2)]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    try:
+        for pid in range(2):
+            env = _clean_env()
+            env["PYTHONPATH"] = repo
+            env["LLMQ_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+            env["LLMQ_NUM_PROCESSES"] = "2"
+            env["LLMQ_PROCESS_ID"] = str(pid)
+            env["LLMQ_CLUSTER_TIMEOUT"] = "60"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "llmq_tpu", "--host", "127.0.0.1",
+                 "--port", str(ports[pid]), "gateway"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        import urllib.request
+        deadline = 60
+        import time as _t
+        healthy = 0
+        t0 = _t.time()
+        while _t.time() - t0 < deadline and healthy < 2:
+            healthy = 0
+            for p in ports:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{p}/health", timeout=2):
+                        healthy += 1
+                except OSError:
+                    pass
+            if healthy < 2:
+                _t.sleep(0.5)
+        assert healthy == 2, "gateways did not become healthy"
+    finally:
+        outs = []
+        for p in procs:
+            p.terminate()
+            try:
+                outs.append(p.communicate(timeout=20)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+    joined = [o for o in outs
+              if "jax.distributed initialised" in o]
+    assert len(joined) == 2, outs
