@@ -212,6 +212,10 @@ def forward_prefill(
     - supports continuation prefill (conversation turn 2+): ``positions``
       carry absolute offsets; new tokens attend to the previously cached
       pages through the same block tables.
+    - each row of ``positions`` must be CONTIGUOUS (``positions[b, 0] +
+      arange(T)``): the TPU attention kernel derives q positions from
+      ``positions[b, 0]`` only (see dispatch_prefill_attention); padding
+      rows past ``lengths`` are discarded so their values don't matter.
     """
     B, T = tokens.shape
 

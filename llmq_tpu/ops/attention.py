@@ -237,6 +237,14 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
     makes XLA insert full-pool defensive copies (measured 3-4x total
     prefill cost), and the gather also materializes the padded window.
     Fallback: gather + blockwise online-softmax attention.
+
+    CONTIGUITY REQUIREMENT (kernel path): ``positions`` rows must be
+    contiguous — the kernel derives every q position as
+    ``positions[b, 0] + row`` and ignores the rest of the array, while
+    the fallback honors ``positions`` elementwise. The executor always
+    passes contiguous chunks (padding rows past ``seq_lens`` are
+    discarded); any caller with genuinely non-contiguous positions must
+    set ``LLMQ_PALLAS=0`` or results will differ between TPU and CPU.
     """
     B, T = q.shape[0], q.shape[1]
     page_size = k_pool.shape[2]

@@ -182,7 +182,10 @@ def _decode_kernel(
 
     @pl.when(c == num_chunks - 1)
     def _():
-        out_ref[0] = (acc_ref[...] / l_ref[...]).astype(out_ref.dtype)
+        # Zero guard: seq_lens[b] == 0 skips every chunk, leaving l at 0
+        # — emit 0 (matching the prefill kernel's flush) instead of 0/0.
+        out_ref[0] = (acc_ref[...]
+                      / jnp.maximum(l_ref[...], 1e-30)).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("pages_per_chunk", "interpret"))

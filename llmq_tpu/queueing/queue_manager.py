@@ -24,6 +24,7 @@ exists.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -107,6 +108,12 @@ class QueueManager:
         # Optional durability (the reference loses every pending message
         # on restart — SURVEY §5): journal mutations, replay on startup.
         self._wal = None
+        # Serializes each queue-mutation + WAL-append pair against the
+        # monitor's live-set snapshot + compaction rewrite. Without it a
+        # message journaled between snapshot and rewrite is erased from
+        # the WAL while still live, so a crash after compaction loses it.
+        # No-op (nullcontext) when the WAL is disabled.
+        self._wal_mu = threading.RLock()
         #: id → (queue, Message) for popped/parked-but-unfinished
         #: messages: they are part of the WAL's live set (redelivery on
         #: restart) but absent from the queue snapshot, so compaction
@@ -188,20 +195,22 @@ class QueueManager:
         """Apply rules, route, push. Returns the queue it landed in."""
         self._apply_rules(message)
         qname = queue_name or self.route_for(message)
-        if self._wal:
-            # Journal BEFORE the push: a pop/complete from a concurrent
-            # worker can only happen after the push succeeds, so records
-            # can never appear out of order in the journal.
-            self._wal.append("push", qname, message.id, message)
-        try:
-            self.queue.push(qname, message)
-        except Exception:
+        with self._wal_guard():
             if self._wal:
-                self._wal.append("remove", qname, message.id)
-            self._op_metric("push", "error")
-            raise
-        if self._wal:
-            self._wal_inflight.pop(message.id, None)  # delayed re-push
+                # Journal BEFORE the push: a pop/complete from a
+                # concurrent worker can only happen after the push
+                # succeeds, so records can never appear out of order in
+                # the journal.
+                self._wal.append("push", qname, message.id, message)
+            try:
+                self.queue.push(qname, message)
+            except Exception:
+                if self._wal:
+                    self._wal.append("remove", qname, message.id)
+                self._op_metric("push", "error")
+                raise
+            if self._wal:
+                self._wal_inflight.pop(message.id, None)  # delayed re-push
         with self._inflight_mu:
             self._inflight[message.id] = qname
         if self._metrics:
@@ -215,10 +224,11 @@ class QueueManager:
         return [self.push_message(m, queue_name) for m in messages]
 
     def pop_message(self, queue_name: str) -> Message:
-        msg = self.queue.pop(queue_name)
-        if self._wal:
-            self._wal.append("pop", queue_name, msg.id)
-            self._wal_inflight[msg.id] = (queue_name, msg)
+        with self._wal_guard():
+            msg = self.queue.pop(queue_name)
+            if self._wal:
+                self._wal.append("pop", queue_name, msg.id)
+                self._wal_inflight[msg.id] = (queue_name, msg)
         if self._metrics:
             lbl = (self.name, queue_name, msg.priority.tier_name)
             self._metrics.pending.labels(*lbl).dec()
@@ -237,12 +247,13 @@ class QueueManager:
     def batch_pop(self, queue_name: str, max_count: int) -> List[Message]:
         out: List[Message] = []
         for _ in range(max_count):
-            m = self.queue.try_pop(queue_name)
-            if m is None:
-                break
-            if self._wal:
-                self._wal.append("pop", queue_name, m.id)
-                self._wal_inflight[m.id] = (queue_name, m)
+            with self._wal_guard():
+                m = self.queue.try_pop(queue_name)
+                if m is None:
+                    break
+                if self._wal:
+                    self._wal.append("pop", queue_name, m.id)
+                    self._wal_inflight[m.id] = (queue_name, m)
             if self._metrics:
                 lbl = (self.name, queue_name, m.priority.tier_name)
                 self._metrics.pending.labels(*lbl).dec()
@@ -268,10 +279,11 @@ class QueueManager:
     def complete_message(self, message: Message, process_time: float = 0.0,
                          queue_name: Optional[str] = None) -> None:
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
-        self.queue.complete_message(qname, message, process_time)
-        if self._wal:
-            self._wal.append("complete", qname, message.id)
-            self._wal_inflight.pop(message.id, None)
+        with self._wal_guard():
+            self.queue.complete_message(qname, message, process_time)
+            if self._wal:
+                self._wal.append("complete", qname, message.id)
+                self._wal_inflight.pop(message.id, None)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -282,10 +294,11 @@ class QueueManager:
     def fail_message(self, message: Message, process_time: float = 0.0,
                      queue_name: Optional[str] = None) -> None:
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
-        self.queue.fail_message(qname, message, process_time)
-        if self._wal:
-            self._wal.append("fail", qname, message.id)
-            self._wal_inflight.pop(message.id, None)
+        with self._wal_guard():
+            self.queue.fail_message(qname, message, process_time)
+            if self._wal:
+                self._wal.append("fail", qname, message.id)
+                self._wal_inflight.pop(message.id, None)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -296,10 +309,11 @@ class QueueManager:
     def requeue_message(self, message: Message, queue_name: Optional[str] = None) -> str:
         """Retry path: return a PROCESSING message to its queue."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
-        self.queue.requeue(qname, message)
-        if self._wal:
-            self._wal.append("requeue", qname, message.id)
-            self._wal_inflight.pop(message.id, None)  # back in the queue
+        with self._wal_guard():
+            self.queue.requeue(qname, message)
+            if self._wal:
+                self._wal.append("requeue", qname, message.id)
+                self._wal_inflight.pop(message.id, None)  # back in the queue
         with self._inflight_mu:
             self._inflight[message.id] = qname
         if self._metrics:
@@ -314,9 +328,10 @@ class QueueManager:
         completed/failed transition — it will re-enter via the delayed
         queue after its retry backoff elapses."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
-        self.queue.requeue_accounting_for(qname)
-        if self._wal:
-            self._wal.append("stash", qname, message.id)
+        with self._wal_guard():
+            self.queue.requeue_accounting_for(qname)
+            if self._wal:
+                self._wal.append("stash", qname, message.id)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -330,11 +345,12 @@ class QueueManager:
         all of this manager's queues."""
         names = [queue_name] if queue_name else self.queue_names()
         for qname in names:
-            msg = self.queue.remove_message(qname, message_id)
-            if msg is not None:
-                if self._wal:
+            with self._wal_guard():
+                msg = self.queue.remove_message(qname, message_id)
+                if msg is not None and self._wal:
                     self._wal.append("remove", qname, message_id)
                     self._wal_inflight.pop(message_id, None)
+            if msg is not None:
                 with self._inflight_mu:
                     self._inflight.pop(message_id, None)
                 if self._metrics:
@@ -347,6 +363,12 @@ class QueueManager:
     def _pop_inflight(self, message_id: str) -> Optional[str]:
         with self._inflight_mu:
             return self._inflight.pop(message_id, None)
+
+    def _wal_guard(self):
+        """Lock pairing a queue mutation with its WAL bookkeeping so the
+        monitor's compaction sees a consistent live set; free (nullcontext)
+        when durability is off."""
+        return self._wal_mu if self._wal else contextlib.nullcontext()
 
     # -- stats / monitor -----------------------------------------------------
 
@@ -390,8 +412,15 @@ class QueueManager:
         # Stale cleanup (real version of the :549-553 stub).
         if self.qconfig.stale_message_age > 0:
             for qname in list(stats):
-                expired = self.queue.expire_older_than(
-                    qname, self.qconfig.stale_message_age)
+                with self._wal_guard():
+                    expired = self.queue.expire_older_than(
+                        qname, self.qconfig.stale_message_age)
+                    for msg in expired:
+                        if self._wal:
+                            # Expired messages must not resurrect on
+                            # restart.
+                            self._wal.append("remove", qname, msg.id)
+                            self._wal_inflight.pop(msg.id, None)
                 if expired:
                     # Keep manager-side accounting consistent: drop the
                     # inflight routing entries and settle the metrics the
@@ -399,11 +428,6 @@ class QueueManager:
                     # stats when the tombstone surfaces).
                     for msg in expired:
                         self._pop_inflight(msg.id)
-                        if self._wal:
-                            # Expired messages must not resurrect on
-                            # restart.
-                            self._wal.append("remove", qname, msg.id)
-                            self._wal_inflight.pop(msg.id, None)
                         if self._metrics:
                             lbl = (self.name, qname, msg.priority.tier_name)
                             self._metrics.pending.labels(*lbl).dec()
@@ -412,11 +436,34 @@ class QueueManager:
                                 len(expired), self.name, qname)
         # Bound the journal: rewrite it as the current live set once
         # dead records dominate (pending snapshot + unfinished pops).
-        if self._wal:
-            live = [(qname, m) for qname in self.queue_names()
-                    for m in self.queue.snapshot(qname)]
-            live.extend(self._wal_inflight.values())
-            self._wal.maybe_compact(live)
+        # Concurrent-compaction protocol (ADVICE r2 medium + review):
+        # _wal_mu is held only while snapshotting the live set and while
+        # swapping the new journal in — the O(live) serialization runs
+        # outside the lock, with concurrent appends journaled normally
+        # AND buffered for replay into the new file before the swap, so
+        # a push mid-compaction is never erased and the data path never
+        # stalls for the rewrite's duration. The cheap counter check
+        # keeps routine ticks from paying for a snapshot at all.
+        if self._wal and self._wal.needs_compact():
+            n_live, ok = 0, False
+            started = False
+            try:
+                with self._wal_mu:
+                    started = self._wal.begin_compact()
+                    if started:
+                        live = [(qname, m) for qname in self.queue_names()
+                                for m in self.queue.snapshot(qname)]
+                        live.extend(self._wal_inflight.values())
+                if started:
+                    n_live = self._wal.write_compact_tmp(live)
+                    ok = True
+            finally:
+                # Unconditional finish once begun — a snapshot or
+                # serialization failure must abort the compaction
+                # (drop buffer, remove tmp), never wedge it open.
+                if started:
+                    with self._wal_mu:
+                        self._wal.finish_compact(n_live, commit=ok)
         # Threshold check (:521-546) with a real actuator callback.
         total = sum(s.pending_count for s in stats.values())
         signal: Optional[ScaleSignal] = None

@@ -41,6 +41,14 @@ _LIVE_PENDING = "pending"
 _LIVE_INFLIGHT = "inflight"
 
 
+def _push_line(qname: str, msg: Message) -> str:
+    """The one serialization of a live message as a journal push record
+    — shared by append/rewrite/compaction so compacted journals can
+    never drift from the live-append format."""
+    return json.dumps({"op": "push", "q": qname, "id": msg.id,
+                       "msg": msg.to_dict()}, default=str)
+
+
 class QueueWAL:
     """Append-only journal of queue mutations for one QueueManager."""
 
@@ -53,6 +61,14 @@ class QueueWAL:
         self._since_sync = 0
         self._records = 0
         self._live = 0
+        # Concurrent-compaction state: while a compaction's tmp file is
+        # being written outside the caller's data-path lock, appends
+        # keep flowing to the CURRENT journal (crash-safe at every
+        # point) and are also buffered here for replay into the tmp
+        # file before the atomic swap.
+        self._compact_buf: Optional[List[str]] = None
+        self._compact_tmp = None  # open file handle for the tmp journal
+        self._closed = False
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
@@ -61,13 +77,18 @@ class QueueWAL:
 
     def append(self, op: str, queue: str, message_id: str,
                message: Optional[Message] = None) -> None:
-        rec: Dict = {"op": op, "q": queue, "id": message_id}
-        if message is not None:
-            rec["msg"] = message.to_dict()
-        line = json.dumps(rec, default=str)
+        if op == "push" and message is not None:
+            line = _push_line(queue, message)
+        else:
+            rec: Dict = {"op": op, "q": queue, "id": message_id}
+            if message is not None:
+                rec["msg"] = message.to_dict()
+            line = json.dumps(rec, default=str)
         with self._mu:
             self._f.write(line + "\n")
             self._f.flush()
+            if self._compact_buf is not None:
+                self._compact_buf.append(line)
             self._since_sync += 1
             self._records += 1
             if op == "push":
@@ -78,25 +99,104 @@ class QueueWAL:
                 os.fsync(self._f.fileno())
                 self._since_sync = 0
 
-    def maybe_compact(self, live: List[Tuple[str, Message]]) -> bool:
-        """Rewrite the journal with only ``live`` (queue, message) pairs
-        when dead records dominate. Returns True if compacted."""
+    def needs_compact(self) -> bool:
+        """Cheap counter check: do dead records dominate enough that a
+        compaction pass is worth it? Callers use this to avoid paying
+        for a live-set snapshot (and the lock held while taking it) on
+        every monitor tick."""
         with self._mu:
-            if self._records < 1024 or (
-                    self._records <= self.compact_ratio * max(1, self._live)):
+            return self._records >= 1024 and (
+                self._records > self.compact_ratio * max(1, self._live))
+
+    # -- concurrent compaction protocol --------------------------------------
+    #
+    # The O(live) tmp-file serialization + fsync must NOT run under the
+    # manager's data-path lock (it would stall every push/pop for
+    # seconds on a deep backlog). Protocol — caller holds its lock only
+    # for begin/finish:
+    #
+    #   with data_path_lock:  live = snapshot(); wal.begin_compact()
+    #   wal.write_compact_tmp(live)        # slow, lock-free; appends
+    #                                      # flow to the old journal AND
+    #                                      # an in-memory buffer
+    #   with data_path_lock:  wal.finish_compact(commit=ok)
+    #                                      # drain buffer → tmp, fsync,
+    #                                      # atomic swap
+    #
+    # Crash at any point is safe: the old journal only ever grows until
+    # the os.replace, so replay sees a complete history.
+
+    def begin_compact(self) -> bool:
+        """Start buffering appends for a concurrent compaction. Returns
+        False if one is already in progress."""
+        with self._mu:
+            if self._compact_buf is not None:
                 return False
-        self.rewrite(live)
-        return True
+            self._compact_buf = []
+            return True
+
+    def write_compact_tmp(self, live: List[Tuple[str, Message]]) -> int:
+        """Serialize the live set to the tmp journal (no locks held —
+        data path keeps flowing). Returns the record count written."""
+        tmp = self.path + ".tmp"
+        f = open(tmp, "w", encoding="utf-8")
+        # Registered before writing so the abort path (finish_compact
+        # commit=False) can close and remove it if a write fails
+        # mid-loop (e.g. ENOSPC) — no fd or partial-file leak.
+        self._compact_tmp = f
+        for qname, msg in live:
+            f.write(_push_line(qname, msg) + "\n")
+        return len(live)
+
+    def finish_compact(self, n_live: int, commit: bool = True) -> None:
+        """Drain records buffered during serialization into the tmp
+        file, fsync, and atomically swap it in (caller holds the
+        data-path lock, so no new appends can race the swap). With
+        ``commit=False`` the tmp file is discarded and journaling
+        returns to normal."""
+        with self._mu:
+            buf, self._compact_buf = self._compact_buf, None
+            f, self._compact_tmp = self._compact_tmp, None
+            # A WAL closed mid-compaction (manager stop raced a slow
+            # serialization) must not be swapped/reopened — abort; the
+            # old journal holds the complete history, so nothing is
+            # lost.
+            if not commit or f is None or self._closed:
+                if f is not None:
+                    f.close()
+                    try:
+                        os.remove(f.name)
+                    except OSError:
+                        pass
+                return
+            for line in buf:
+                f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            self._f.close()
+            os.replace(f.name, self.path)
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._records = n_live + len(buf)
+            self._live = min(self._live, self._records)
+            self._since_sync = 0
+        log.info("wal compacted to %d live (+%d concurrent) records (%s)",
+                 n_live, len(buf), self.path)
 
     def rewrite(self, live: List[Tuple[str, Message]]) -> None:
-        """Atomically replace the journal with push records for ``live``."""
+        """Atomically replace the journal with push records for ``live``.
+
+        Synchronous variant (startup replay compaction, tests); must not
+        run while a concurrent compaction is in flight — the in-flight
+        finish would clobber this rewrite with a stale snapshot."""
         tmp = self.path + ".tmp"
         with self._mu:
+            if self._compact_buf is not None:
+                raise RuntimeError(
+                    "rewrite() during an in-flight concurrent compaction")
             with open(tmp, "w", encoding="utf-8") as f:
                 for qname, msg in live:
-                    f.write(json.dumps(
-                        {"op": "push", "q": qname, "id": msg.id,
-                         "msg": msg.to_dict()}, default=str) + "\n")
+                    f.write(_push_line(qname, msg) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
             self._f.close()
@@ -110,6 +210,19 @@ class QueueWAL:
 
     def close(self) -> None:
         with self._mu:
+            self._closed = True
+            # Abort any in-flight compaction (a monitor thread that
+            # outlived stop()'s join timeout): drop its buffer and tmp
+            # file; finish_compact sees _closed and will not swap or
+            # reopen the journal after this point.
+            self._compact_buf = None
+            f, self._compact_tmp = self._compact_tmp, None
+            if f is not None:
+                try:
+                    f.close()
+                    os.remove(f.name)
+                except OSError:
+                    pass
             try:
                 self._f.flush()
                 os.fsync(self._f.fileno())
