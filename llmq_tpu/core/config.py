@@ -193,6 +193,10 @@ class ModelConfig:
     # checkpoints must leave this False.
     meta_rope_layout: bool = False
     dtype: str = "bfloat16"
+    # "" | "int8": w8a8 dynamic quantization (ops/quant.py). int8 halves
+    # the weight HBM footprint/bandwidth — the only way llama3-8B fits a
+    # single 16 GB v5e chip (BASELINE config #2).
+    quantization: str = ""
     max_seq_len: int = 2048
     vocab_size: int = 0                 # 0 → model default
 

@@ -57,6 +57,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
                 f"vocab ({mcfg.vocab_size}) — ids would silently clip and "
                 f"EOS could never be sampled; set model.vocab_size or pick "
                 f"a matching tokenizer")
+        quant = getattr(cfg.model, "quantization", "")
+        if quant not in ("", "int8"):
+            raise ValueError(f"unknown model.quantization {quant!r} "
+                             f"(supported: 'int8')")
         if params is None:
             path = cfg.model.checkpoint_path
             if path and path.endswith(".safetensors.d"):
@@ -68,7 +72,23 @@ def build_engine(cfg: Config, *, name: str = "engine0",
                 # worse than not serving.
                 params = load_checkpoint(path)
             if params is None:
-                params = init_params(jax.random.PRNGKey(0), mcfg)
+                if quant == "int8":
+                    # Quantize leaf-by-leaf during init: materializing the
+                    # full bf16 tree first would OOM the very chip int8
+                    # exists to fit (llama3-8b bf16 = 16 GB = all of v5e).
+                    from llmq_tpu.models.llama import init_params_quantized
+                    params = init_params_quantized(jax.random.PRNGKey(0),
+                                                   mcfg)
+                else:
+                    params = init_params(jax.random.PRNGKey(0), mcfg)
+        if quant == "int8":
+            from llmq_tpu.ops.quant import quantize_params
+            # Idempotent: a tree already quantized (init path above, or a
+            # caller-provided quantized tree) passes through untouched.
+            # Checkpoint-loaded bf16 trees are quantized here — for 8B
+            # that requires the checkpoint itself to be loaded shard-wise
+            # on a host with enough RAM (checkpoint.py loads to host).
+            params = quantize_params(params)
         executor = JaxExecutor(
             mcfg, params,
             batch_size=ex.max_batch_size,

@@ -34,6 +34,8 @@ from llmq_tpu.ops.attention import (dispatch_prefill_attention,
                                     paged_decode_step,
                                     paged_kv_write_prefill)
 from llmq_tpu.ops.norms import rms_norm
+from llmq_tpu.ops.quant import (embed_lookup, is_quantized, layer_slice,
+                                linear, tied_head_logits)
 from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
 
 Params = Dict[str, Any]
@@ -140,6 +142,53 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     return params
 
 
+def init_params_quantized(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Random-init directly into int8 quant leaves (ops/quant layout).
+
+    Generates and quantizes ONE weight per jitted call so the bf16
+    transient never exceeds a single leaf — materializing the full bf16
+    tree for llama3-8B (16 GB) before quantizing would OOM the very chip
+    int8 exists to fit. Matches ``quantize_params(init_params(...))``
+    numerically leaf-by-leaf (same keys, same init)."""
+    from llmq_tpu.ops.quant import quantize_embedding, quantize_weight
+
+    L, D, H, HKV, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.ffn_dim, cfg.vocab_size)
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 10)
+
+    def _gen(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    @partial(jax.jit, static_argnames=("shape", "fan_in"))
+    def qinit(k, shape, fan_in):
+        return quantize_weight(_gen(k, shape, fan_in), axis=-2)
+
+    @partial(jax.jit, static_argnames=("shape", "fan_in"))
+    def einit(k, shape, fan_in):
+        return quantize_embedding(_gen(k, shape, fan_in))
+
+    params: Params = {
+        "embed": einit(keys[0], shape=(V, D), fan_in=D),
+        "layers": {
+            "wq": qinit(keys[1], shape=(L, D, H * hd), fan_in=D),
+            "wk": qinit(keys[2], shape=(L, D, HKV * hd), fan_in=D),
+            "wv": qinit(keys[3], shape=(L, D, HKV * hd), fan_in=D),
+            "wo": qinit(keys[4], shape=(L, H * hd, D), fan_in=H * hd),
+            "w_gate": qinit(keys[5], shape=(L, D, F), fan_in=D),
+            "w_up": qinit(keys[6], shape=(L, D, F), fan_in=D),
+            "w_down": qinit(keys[7], shape=(L, F, D), fan_in=F),
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = qinit(keys[8], shape=(D, V), fan_in=D)
+    return params
+
+
 def param_count(params: Params) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
@@ -176,9 +225,20 @@ def kv_bytes_per_token(cfg: LlamaConfig,
 
 def init_kv_pages(cfg: LlamaConfig, num_pages: int, page_size: int,
                   dtype: Optional[Any] = None) -> KVCache:
-    """Global paged KV pool: (L, P, page_size, H_kv, head_dim) per K/V.
-    Page 0 is reserved as the null/padding page."""
-    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    """Global paged KV pool: (L, P, page_size, H_kv·head_dim) per K/V.
+    Page 0 is reserved as the null/padding page.
+
+    The KV-head and head-dim axes are stored FLAT as one trailing axis.
+    This is deliberate and load-bearing: the Pallas kernels DMA pages as
+    (page_size, H_kv·D) tiles (lane dim 128-aligned), and any 5-D⇄4-D
+    reshape between the per-layer aliased kernel calls makes XLA's
+    layout assignment materialize full-pool copies — measured at
+    ~0.65 ms per pool per layer call on v5e, which dominated the entire
+    r2 decode step. Helpers needing heads unflatten VALUES (gathers),
+    never the pool buffer itself.
+    """
+    shape = (cfg.n_layers, num_pages, page_size,
+             cfg.n_kv_heads * cfg.head_dim)
     dt = dtype or cfg.dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -186,10 +246,21 @@ def init_kv_pages(cfg: LlamaConfig, num_pages: int, page_size: int,
 # -- forward ------------------------------------------------------------------
 
 def _mlp(h: jnp.ndarray, w_gate, w_up, w_down) -> jnp.ndarray:
-    """SwiGLU."""
-    g = jnp.dot(h, w_gate)
-    u = jnp.dot(h, w_up)
-    return jnp.dot(jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u, w_down)
+    """SwiGLU. Weights may be bf16 arrays or int8 quant leaves (ops/quant)."""
+    g = linear(h, w_gate)
+    u = linear(h, w_up)
+    return linear(jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u, w_down)
+
+
+def _logits(params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    """Final projection → f32 logits, for bf16 or int8-quantized heads."""
+    head = params.get("lm_head")
+    if head is not None:
+        return linear(h, head).astype(jnp.float32)
+    embed = params["embed"]
+    if is_quantized(embed):
+        return tied_head_logits(embed, h)
+    return jnp.dot(h, embed.T).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -219,7 +290,7 @@ def forward_prefill(
     """
     B, T = tokens.shape
 
-    h = params["embed"][tokens].astype(cfg.dtype)  # (B, T, D)
+    h = embed_lookup(params["embed"], tokens, cfg.dtype)  # (B, T, D)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)  # (B,T,half)
 
     # Absolute visible history per row: last valid position + 1.
@@ -238,12 +309,12 @@ def forward_prefill(
     k_pool, v_pool = kv_cache["k"], kv_cache["v"]
     for l in range(cfg.n_layers):
         hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
-        q = jnp.dot(hn, lp["wq"][l]).reshape(B, T, cfg.n_heads,
-                                             cfg.head_dim)
-        k = jnp.dot(hn, lp["wk"][l]).reshape(B, T, cfg.n_kv_heads,
-                                             cfg.head_dim)
-        v = jnp.dot(hn, lp["wv"][l]).reshape(B, T, cfg.n_kv_heads,
-                                             cfg.head_dim)
+        q = linear(hn, layer_slice(lp["wq"], l)).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = linear(hn, layer_slice(lp["wk"], l)).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = linear(hn, layer_slice(lp["wv"], l)).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # Write this layer's KV into its slice of the pool.
@@ -254,15 +325,13 @@ def forward_prefill(
         # causality enforced via absolute positions.
         attn = dispatch_prefill_attention(q, k_pool, v_pool, block_tables,
                                           positions, seq_lens, l)
-        h = h + jnp.dot(attn.reshape(B, T, -1), lp["wo"][l])
+        h = h + linear(attn.reshape(B, T, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
-        h = h + _mlp(hn2, lp["w_gate"][l], lp["w_up"][l], lp["w_down"][l])
+        h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
+                     layer_slice(lp["w_up"], l), layer_slice(lp["w_down"], l))
     new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    head = params.get("lm_head")
-    logits = (jnp.dot(h, head) if head is not None
-              else jnp.dot(h, params["embed"].T))
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return _logits(params, h), {"k": new_k, "v": new_v}
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -285,7 +354,7 @@ def forward_decode(
     B = tokens.shape[0]
     page_sz = kv_cache["k"].shape[2]
 
-    h = params["embed"][tokens].astype(cfg.dtype)          # (B, D)
+    h = embed_lookup(params["embed"], tokens, cfg.dtype)   # (B, D)
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim,
                             cfg.rope_theta)                # (B,1,half)
     page_of = block_tables[jnp.arange(B), positions // page_sz]
@@ -306,11 +375,12 @@ def forward_decode(
     k_pool, v_pool = kv_cache["k"], kv_cache["v"]
     for l in range(cfg.n_layers):
         hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
-        q = jnp.dot(hn, lp["wq"][l]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
-        k = jnp.dot(hn, lp["wk"][l]).reshape(B, 1, cfg.n_kv_heads,
-                                             cfg.head_dim)
-        v = jnp.dot(hn, lp["wv"][l]).reshape(B, 1, cfg.n_kv_heads,
-                                             cfg.head_dim)
+        q = linear(hn, layer_slice(lp["wq"], l)).reshape(
+            B, 1, cfg.n_heads, cfg.head_dim)
+        k = linear(hn, layer_slice(lp["wk"], l)).reshape(
+            B, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = linear(hn, layer_slice(lp["wv"], l)).reshape(
+            B, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)[:, 0]                  # (B, H, D)
         k = apply_rope(k, cos, sin)[:, 0]                  # (B, H_kv, D)
         v = v[:, 0]
@@ -319,15 +389,13 @@ def forward_decode(
         attn, k_pool, v_pool = paged_decode_step(
             q, k, v, k_pool, v_pool, block_tables, seq_lens,
             page_of, slot_of, jnp.int32(l))                # (B, H, D)
-        h = h + jnp.dot(attn.reshape(B, -1), lp["wo"][l])
+        h = h + linear(attn.reshape(B, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
-        h = h + _mlp(hn2, lp["w_gate"][l], lp["w_up"][l], lp["w_down"][l])
+        h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
+                     layer_slice(lp["w_up"], l), layer_slice(lp["w_down"], l))
     new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    head = params.get("lm_head")
-    logits = (jnp.dot(h, head) if head is not None
-              else jnp.dot(h, params["embed"].T))
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return _logits(params, h), {"k": new_k, "v": new_v}
 
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,

@@ -112,24 +112,27 @@ def paged_decode_attention(
 
 def paged_decode_attention_pooled(
     q: jnp.ndarray,            # (B, H, D)
-    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D) all-layer pool
-    v_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv·D) all-layer pool
+    v_pool: jnp.ndarray,       # (L, P, page_size, H_kv·D)
     block_tables: jnp.ndarray,  # (B, max_pages) int32
     seq_lens: jnp.ndarray,     # (B,) int32
     layer: jnp.ndarray,        # scalar int32 — which layer's pages to read
 ) -> jnp.ndarray:
-    """Decode attention reading layer ``layer`` of the stacked pool.
+    """Decode attention reading layer ``layer`` of the stacked FLAT pool
+    (see models/llama.py:init_kv_pages for why the pool stores H_kv·D
+    as one axis).
 
     The pool keeps its layer dimension so forward_decode's unrolled
     layer loop threads one pool buffer through every layer (scan
     formulations force XLA to materialize pool copies — see the
     comment in llama.py:forward_decode). The combined gather
-    ``k_pool[layer, block_tables]`` stays a single XLA gather.
+    ``k_pool[layer, block_tables]`` stays a single XLA gather; only the
+    gathered VALUE is unflattened to heads, never the pool buffer.
     """
     B, H, D = q.shape
     page_size = k_pool.shape[2]
     S = block_tables.shape[1] * page_size
-    Hkv = k_pool.shape[3]
+    Hkv = k_pool.shape[3] // D
     k = k_pool[layer, block_tables].reshape(B, S, Hkv, D)
     v = v_pool[layer, block_tables].reshape(B, S, Hkv, D)
     return _gqa_attend(q, k, v, seq_lens)
@@ -143,7 +146,7 @@ def _kernel_route(k_pool, *, extra_ok: bool = True):
     TPU backend or ``LLMQ_PALLAS=interpret`` (CI coverage of kernel
     bodies without a TPU)."""
     mode = os.environ.get("LLMQ_PALLAS", "auto")
-    aligned = (k_pool.shape[3] * k_pool.shape[4]) % 128 == 0
+    aligned = k_pool.shape[3] % 128 == 0
     if mode == "0" or not extra_ok or not aligned:
         return False, False
     on_tpu = jax.default_backend() == "tpu"
@@ -163,16 +166,19 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
     scatter costs ~13µs/row on TPU regardless of row size and would
     dominate the whole decode step. Elsewhere (and for prefill, whose
     rows share pages): the .at[] scatter.
-    Pools (L, P, page_size, H_kv, D); k_new/v_new (N, H_kv, D).
+    Pools FLAT (L, P, page_size, H_kv·D); k_new/v_new (N, H_kv, D).
     """
+    N = k_new.shape[0]
+    kn = k_new.reshape(N, -1)
+    vn = v_new.reshape(N, -1)
     use_kernel, interpret = _kernel_route(k_pool, extra_ok=distinct_pages)
     if use_kernel:
         from llmq_tpu.ops.pallas.kv_write import kv_cache_write_pallas
-        return kv_cache_write_pallas(k_pool, v_pool, k_new, v_new,
+        return kv_cache_write_pallas(k_pool, v_pool, kn, vn,
                                      page_of, slot_of, layer,
                                      interpret=interpret)
-    k_pool = k_pool.at[layer, page_of, slot_of].set(k_new)
-    v_pool = v_pool.at[layer, page_of, slot_of].set(v_new)
+    k_pool = k_pool.at[layer, page_of, slot_of].set(kn)
+    v_pool = v_pool.at[layer, page_of, slot_of].set(vn)
     return k_pool, v_pool
 
 
@@ -192,6 +198,7 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
     """
     B, T = k.shape[0], k.shape[1]
     page_size = k_pool.shape[2]
+    GD = k_pool.shape[3]
     use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1))
     if use_kernel:
         from llmq_tpu.ops.pallas.kv_write import kv_prefill_write_pallas
@@ -201,14 +208,13 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
         # whole pages — T//page_size + 1 under-allocates for non-multiple
         # buckets and dynamic_update_slice would silently clamp.
         n_wp = -(-T // page_size) + 1
-        Hkv, D = k.shape[2], k.shape[3]
-        aligned_k = jnp.zeros((n_wp * page_size, Hkv, D), k.dtype)
-        aligned_v = jnp.zeros((n_wp * page_size, Hkv, D), v.dtype)
+        aligned_k = jnp.zeros((n_wp * page_size, GD), k.dtype)
+        aligned_v = jnp.zeros((n_wp * page_size, GD), v.dtype)
         off = start % page_size
-        aligned_k = jax.lax.dynamic_update_slice(aligned_k, k[0],
-                                                 (off, 0, 0))
-        aligned_v = jax.lax.dynamic_update_slice(aligned_v, v[0],
-                                                 (off, 0, 0))
+        aligned_k = jax.lax.dynamic_update_slice(
+            aligned_k, k[0].reshape(T, GD), (off, 0))
+        aligned_v = jax.lax.dynamic_update_slice(
+            aligned_v, v[0].reshape(T, GD), (off, 0))
         return kv_prefill_write_pallas(
             k_pool, v_pool, aligned_k, aligned_v, block_tables[0],
             start, n_tok, layer, interpret=interpret)
@@ -221,10 +227,8 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
         block_tables[jnp.repeat(jnp.arange(B), T), flat_pos // page_size],
         0)
     slot_of = jnp.where(flat_valid, flat_pos % page_size, 0)
-    k_pool = k_pool.at[layer, page_of, slot_of].set(
-        k.reshape(-1, k.shape[2], k.shape[3]))
-    v_pool = v_pool.at[layer, page_of, slot_of].set(
-        v.reshape(-1, v.shape[2], v.shape[3]))
+    k_pool = k_pool.at[layer, page_of, slot_of].set(k.reshape(-1, GD))
+    v_pool = v_pool.at[layer, page_of, slot_of].set(v.reshape(-1, GD))
     return k_pool, v_pool
 
 
@@ -257,8 +261,8 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
             layer, interpret=interpret)
         return out[None]
     S = block_tables.shape[1] * page_size
-    Hkv = k_pool.shape[3]
-    D = k_pool.shape[4]
+    D = q.shape[3]
+    Hkv = k_pool.shape[3] // D
     k_hist = k_pool[layer, block_tables].reshape(B, S, Hkv, D)
     v_hist = v_pool[layer, block_tables].reshape(B, S, Hkv, D)
     return blockwise_prefill_attention(q, k_hist, v_hist, positions,
@@ -277,7 +281,10 @@ def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
     the row-RMW write kernel / scatter followed by pooled attention.
     Returns (attn, k_pool, v_pool).
     """
-    use_kernel, interpret = _kernel_route(k_pool)
+    # page_size % 8: the fused kernel writes back the 8-sublane tile
+    # holding the new row (fused_decode.py) — sub-8 pages can't.
+    use_kernel, interpret = _kernel_route(
+        k_pool, extra_ok=k_pool.shape[2] % 8 == 0)
     if use_kernel:
         from llmq_tpu.ops.pallas.fused_decode import (
             fused_decode_attention_pallas)

@@ -1,32 +1,57 @@
 """Pallas TPU kernel: FUSED decode attention + KV-cache write.
 
-One kernel per layer instead of two (kv_write + paged_attention): the
-per-layer pallas-call launch overhead is a measurable slice of the
-decode step (32 launches/step at 16 layers), and the separate write
-kernel pays its own page round-trip that this kernel already makes.
+One kernel per layer does both the current tokens' cache write and the
+paged attention read — vs two kernels (kv_write + paged_attention) with
+their doubled launch overhead and a separate page round-trip.
 
-How the fusion works, per sequence row b:
+Design (v3 — third shape of this kernel; the numbers that drove it):
 
-- The current token's K/V row does NOT go through HBM before attention.
-  The kernel DMAs the history pages as usual; when the chunk containing
-  the current position arrives in VMEM, the new row is **merged into
-  the fetched scratch** (vector select at the page/slot offset), the
-  merged page is DMA'd back to the pool (input/output-aliased — this IS
-  the cache write), and attention computes over the merged scratch — so
-  the current token attends to itself without ever reading its own
-  stale slot.
-- Masking is ``kv_pos < seq_len`` with ``seq_len = pos+1`` — identical
-  to the unfused semantics, because the merged scratch holds the
-  current token at its true slot.
-- Inactive rows (EOS-latched inside a decode chunk) redirect their
-  write to reserved page 0 (never read); their attention output is
-  discarded by the engine.
+- r2 kernel: per-row grid, per-row page-merge writeback, within-row
+  double buffering → ~34µs/row at B=64 (≈16ms of a 21ms decode step),
+  flat in seq_len. The merge (full-batch masked row extraction,
+  page-wide selects, staging copies) and the per-row cold DMA stall
+  dominated; actual page bandwidth was noise.
+- **Row tiles**: the grid is (B/R tiles, chunks); each step fetches R
+  rows' pages and runs ONE batched dot_general over the tile —
+  amortizing per-step scalar/dispatch overhead R× vs per-row grids.
+- **Cross-pair prefetch chain**: each live (tile, chunk) pair starts
+  the next live pair's DMAs (crossing tile boundaries) into the
+  alternate scratch slot; slot parity is a consumed-fetch counter in
+  SMEM, not ``chunk % 2``, because dead chunks are skipped.
+- **Tile-sliced merge**: the current token's K/V row is selected into
+  its (already fetched) page in scratch and the merged page is written
+  back as ONE full-page DMA per pool. The tile's k_new/v_new rows
+  arrive as a BlockSpec slice (free), so the r2 kernel's masked
+  extraction disappears; sub-page DMAs are impossible anyway (Mosaic
+  requires 2nd-minor slices tile-aligned — a (1, GD) row write doesn't
+  compile). Writeback waits land AFTER the attention math, so the DMA
+  overlaps compute but is guaranteed done before this scratch slot can
+  be refetched (the next pair's prefetch targets the other slot; the
+  pair after that reuses this one only after this step ends).
+- Fetch/wait liveness is keyed on ``eff_len = max(seq_len, 1)`` so a
+  ``seq_len == 0`` row still pairs starts with waits exactly.
+- Scratch is zeroed ONCE per call: dead positions inside a live chunk
+  contribute exactly 0 through the masked softmax, which is safe only
+  if stale scratch is finite (uninitialized VMEM can hold NaN bit
+  patterns; NaN + -1e30 = NaN and 0·NaN = NaN).
+- The mask rides an additive bf16 bias INPUT (0 / -1e30, broadcast
+  over H so the block's last-two dims are tile-aligned): Mosaic can't
+  stack SMEM scalars into vectors inside the kernel.
+- The online-softmax max floor is -1e29, not -inf: a fully-masked
+  chunk then yields p = exp(-1e30 + 1e29) = 0 exactly instead of
+  exp(0) = 1 pulling stale V into the accumulator.
+- DMA semaphores are shared per (pool, slot): TPU sflag space is ~2KB
+  (≈500 semaphores) — a per-(row, page) array doesn't fit. All sharers
+  copy identical byte counts, so per-copy waits drain in any order.
 
-Same shape strategy as the other kernels: block-diagonal Q
-(one 2D MXU matmul for all heads), pages flattened to (ps, H_kv·D),
-online softmax in f32 scratch, double-buffered chunk DMA, dead chunks
-skipped. Constraint: all live rows target distinct pages (decode
-invariant), H_kv·D % 128 == 0.
+Chunk sizing: per-DMA issue cost is per PAGE, so serving configs want
+large pages (128-256 tokens); chunks default to ~256 tokens so chunks
+beyond a row's length skip both their DMAs and their masked matmuls.
+
+Same shape strategy as the other kernels: block-diagonal Q (one
+batched MXU matmul for all heads), pages flattened to (ps, H_kv·D),
+online softmax in f32 scratch. Constraints: all live rows target
+distinct pages (decode invariant), H_kv·D % 128 == 0.
 """
 
 from __future__ import annotations
@@ -40,6 +65,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+_CONSUMED = 0   # SMEM state: fetches consumed so far (slot parity)
+
 
 def _fused_kernel(
     # scalar prefetch (SMEM)
@@ -48,137 +75,185 @@ def _fused_kernel(
     write_page_ref,     # (B,) int32 — pool page id for the current token
     layer_ref,          # (1,) int32
     # inputs
-    q_ref,              # (1, H, GD) VMEM — block-diagonal
-    k_new_ref,          # (B_pad, GD) VMEM — current tokens' K rows
-    v_new_ref,          # (B_pad, GD) VMEM
+    q_ref,              # (R, H, GD) VMEM — block-diagonal, this tile
+    k_new_ref,          # (R, GD) VMEM — this tile's current K rows
+    v_new_ref,          # (R, GD) VMEM
+    bias_ref,           # (R, 1, H, S) bf16 — 0 live, -1e30 masked
     k_hbm,              # (L, P, ps, GD) ANY — aliased to output 1
     v_hbm,              # (L, P, ps, GD) ANY — aliased to output 2
     # outputs
-    out_ref,            # (1, H, GD) VMEM — attention output
-    k_out,              # aliased pools (DMAs target these)
+    out_ref,            # (R, H, GD) VMEM — attention output, this tile
+    k_out,              # aliased pools (all DMAs target these)
     v_out,
     # scratch
-    m_ref, l_ref, acc_ref,          # (H,1),(H,1),(H,GD) f32
-    k_scratch, v_scratch,           # (2, ppc, ps, GD) VMEM
-    sem,                            # DMA (2, 2, ppc)
-    wsem,                           # DMA (2,) — merged-page writeback
+    m_ref, l_ref, acc_ref,          # (R,H,1),(R,H,1),(R,H,GD) f32
+    k_scratch, v_scratch,           # (2, R, ppc, ps, GD) VMEM
+    state,                          # SMEM (1,) int32
+    sem,                            # DMA (2, 2) — [pool, slot] fetches
+    wsem,                           # DMA (2, R) — [pool, row] writebacks
     *,
+    rows_per_tile: int,
     pages_per_chunk: int,
     page_size: int,
     num_chunks: int,
+    batch: int,
     scale: float,
 ):
-    b = pl.program_id(0)
+    t = pl.program_id(0)
     c = pl.program_id(1)
+    R = rows_per_tile
     ppc = pages_per_chunk
-    seq_len = seq_lens_ref[b]
+    chunk_tokens = ppc * page_size
+    num_tiles = pl.num_programs(0)
     lyr = layer_ref[0]
-    cur_pos = seq_len - 1
-    cur_page_j = cur_pos // page_size       # page index within the table
-    cur_chunk = cur_page_j // ppc
-    n_pad = k_new_ref.shape[0]
 
-    def start_chunk(chunk, slot):
+    def row_c_last(row):
+        eff = jnp.maximum(seq_lens_ref[row], 1)
+        return (eff - 1) // chunk_tokens
+
+    def tile_c_last(tile):
+        m = row_c_last(tile * R)
+        for r in range(1, R):
+            m = jnp.maximum(m, row_c_last(tile * R + r))
+        return m
+
+    def start_fetch(tile, chunk, slot):
+        """Start DMAs for every live (row, page) of (tile, chunk).
+        Liveness uses the TARGET rows' eff_len — must match wait_fetch
+        exactly or semaphores corrupt."""
         base = chunk * ppc
-        for j in range(ppc):
-            page_start = (base + j) * page_size
-            in_grid = chunk < num_chunks
-            live = jnp.logical_and(in_grid, page_start < seq_len)
+        for r in range(R):
+            row = tile * R + r
+            eff = jnp.maximum(seq_lens_ref[row], 1)
+            for j in range(ppc):
+                live = (base + j) * page_size < eff
 
-            @pl.when(live)
-            def _():
-                pid = block_tables_ref[b, base + j]
-                pltpu.make_async_copy(
-                    k_hbm.at[lyr, pid], k_scratch.at[slot, j],
-                    sem.at[0, slot, j]).start()
-                pltpu.make_async_copy(
-                    v_hbm.at[lyr, pid], v_scratch.at[slot, j],
-                    sem.at[1, slot, j]).start()
+                @pl.when(live)
+                def _():
+                    pid = block_tables_ref[row, base + j]
+                    pltpu.make_async_copy(
+                        k_out.at[lyr, pid], k_scratch.at[slot, r, j],
+                        sem.at[0, slot]).start()
+                    pltpu.make_async_copy(
+                        v_out.at[lyr, pid], v_scratch.at[slot, r, j],
+                        sem.at[1, slot]).start()
 
-            @pl.when(jnp.logical_and(in_grid, jnp.logical_not(live)))
-            def _():
-                v_scratch[slot, j] = jnp.zeros_like(v_scratch[slot, j])
-
-    def wait_chunk(chunk, slot):
+    def wait_fetch(tile, chunk, slot):
         base = chunk * ppc
-        for j in range(ppc):
-            page_start = (base + j) * page_size
+        for r in range(R):
+            row = tile * R + r
+            eff = jnp.maximum(seq_lens_ref[row], 1)
+            for j in range(ppc):
+                live = (base + j) * page_size < eff
 
-            @pl.when(page_start < seq_len)
-            def _():
-                pltpu.make_async_copy(
-                    k_hbm.at[lyr, block_tables_ref[b, base + j]],
-                    k_scratch.at[slot, j], sem.at[0, slot, j]).wait()
-                pltpu.make_async_copy(
-                    v_hbm.at[lyr, block_tables_ref[b, base + j]],
-                    v_scratch.at[slot, j], sem.at[1, slot, j]).wait()
+                @pl.when(live)
+                def _():
+                    pid = block_tables_ref[row, base + j]
+                    pltpu.make_async_copy(
+                        k_out.at[lyr, pid], k_scratch.at[slot, r, j],
+                        sem.at[0, slot]).wait()
+                    pltpu.make_async_copy(
+                        v_out.at[lyr, pid], v_scratch.at[slot, r, j],
+                        sem.at[1, slot]).wait()
+
+    @pl.when(jnp.logical_and(t == 0, c == 0))
+    def _():
+        state[_CONSUMED] = 0
+        # BOTH pools: dead positions contribute through q·k_stale +
+        # bias and p·v_stale — the additive mask only yields exactly-0
+        # contributions if stale scratch is finite (fresh VMEM can hold
+        # NaN, and NaN + -1e30 = NaN straight through the softmax).
+        k_scratch[...] = jnp.zeros_like(k_scratch)
+        v_scratch[...] = jnp.zeros_like(v_scratch)
+        start_fetch(0, 0, 0)
 
     @pl.when(c == 0)
     def _():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        # Floor at -1e29 (not -1e30): if every position of a chunk is
+        # masked, m stays at the floor and p = exp(-1e30 - (-1e29))
+        # underflows to exactly 0 — with the floor at the mask value
+        # itself, p would be exp(0) = 1 and stale V would leak.
+        m_ref[...] = jnp.full_like(m_ref, -1e29)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        start_chunk(0, 0)
 
-    slot = jax.lax.rem(c, 2)
-    chunk_start = c * ppc * page_size
+    c_last = tile_c_last(t)
+    fetched = c <= c_last
 
-    @pl.when(chunk_start < seq_len)
+    @pl.when(fetched)
     def _():
-        start_chunk(c + 1, 1 - slot)
-        wait_chunk(c, slot)
+        consumed = state[_CONSUMED]
+        slot = jax.lax.rem(consumed, 2)
+        nslot = 1 - slot
 
-        # Merge the current token's row into the freshly fetched page
-        # and write the merged page back — the fused cache write.
-        @pl.when(c == cur_chunk)
+        # Prefetch the next live pair (possibly the next tile) while
+        # this pair computes — kills the per-tile cold stall.
+        @pl.when(c < c_last)
         def _():
-            jj = cur_page_j - cur_chunk * ppc          # page within chunk
-            s = cur_pos - cur_page_j * page_size       # slot within page
-            rows = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 1), 0)
-            msk = (rows == b).astype(jnp.float32)
-            k_row = jnp.sum(k_new_ref[...].astype(jnp.float32) * msk,
-                            axis=0, keepdims=True)     # (1, GD)
-            v_row = jnp.sum(v_new_ref[...].astype(jnp.float32) * msk,
-                            axis=0, keepdims=True)
-            # jj/s are traced: select the page via per-page `when`.
+            start_fetch(t, c + 1, nslot)
+
+        @pl.when(jnp.logical_and(c == c_last, t + 1 < num_tiles))
+        def _():
+            start_fetch(t + 1, 0, nslot)
+
+        wait_fetch(t, c, slot)
+
+        # Merge each row whose current position lives in this chunk
+        # into its fetched page, and start the full-page writeback —
+        # this IS the cache write. The new rows arrive pre-sliced for
+        # the tile, so the select is one (ps, GD) where per row.
+        kn_all = k_new_ref[...]                          # (R, GD)
+        vn_all = v_new_ref[...]
+        for r in range(R):
+            row = t * R + r
+            cur = seq_lens_ref[row] - 1
+            cur_page_j = cur // page_size
+            cur_chunk = cur_page_j // ppc                # -1 if seq==0
+            jj = cur_page_j - cur_chunk * ppc
+            s = cur - cur_page_j * page_size
+            do_merge = c == cur_chunk
+            # Write back only the 8-sublane tile holding the new row,
+            # not the whole page: at page_size 256 a full-page RMW write
+            # is 256x write amplification (~33 MB/call at B=64 — half
+            # the kernel's traffic). The tile offset is a multiple of 8
+            # by construction, satisfying Mosaic's sublane alignment.
+            tile_lo = (s // 8) * 8
             for j in range(ppc):
-                @pl.when(j == jj)
+                @pl.when(jnp.logical_and(do_merge, j == jj))
                 def _():
                     sl = jax.lax.broadcasted_iota(
                         jnp.int32, (page_size, 1), 0)
                     keep = sl != s
-                    k_scratch[slot, j] = jnp.where(
-                        keep, k_scratch[slot, j],
-                        k_row.astype(k_scratch.dtype))
-                    v_scratch[slot, j] = jnp.where(
-                        keep, v_scratch[slot, j],
-                        v_row.astype(v_scratch.dtype))
-                    wp = write_page_ref[b]
+                    k_scratch[slot, r, j] = jnp.where(
+                        keep, k_scratch[slot, r, j],
+                        kn_all[r:r + 1].astype(k_scratch.dtype))
+                    v_scratch[slot, r, j] = jnp.where(
+                        keep, v_scratch[slot, r, j],
+                        vn_all[r:r + 1].astype(v_scratch.dtype))
+                    wp = write_page_ref[row]
                     pltpu.make_async_copy(
-                        k_scratch.at[slot, j], k_out.at[lyr, wp],
-                        wsem.at[0]).start()
+                        k_scratch.at[slot, r, j, pl.ds(tile_lo, 8)],
+                        k_out.at[lyr, wp, pl.ds(tile_lo, 8)],
+                        wsem.at[0, r]).start()
                     pltpu.make_async_copy(
-                        v_scratch.at[slot, j], v_out.at[lyr, wp],
-                        wsem.at[1]).start()
-                    pltpu.make_async_copy(
-                        k_scratch.at[slot, j], k_out.at[lyr, wp],
-                        wsem.at[0]).wait()
-                    pltpu.make_async_copy(
-                        v_scratch.at[slot, j], v_out.at[lyr, wp],
-                        wsem.at[1]).wait()
+                        v_scratch.at[slot, r, j, pl.ds(tile_lo, 8)],
+                        v_out.at[lyr, wp, pl.ds(tile_lo, 8)],
+                        wsem.at[1, r]).start()
 
-        S = ppc * page_size
-        GD = acc_ref.shape[1]
-        q = q_ref[0]                                   # (H, GD)
-        k = k_scratch[slot].reshape(S, GD)
-        v = v_scratch[slot].reshape(S, GD)
-        dims = (((1,), (1,)), ((), ()))
+        S = chunk_tokens
+        GD = acc_ref.shape[2]
+        q = q_ref[...]                                  # (R, H, GD)
+        k = k_scratch[slot].reshape(R, S, GD)
+        v = v_scratch[slot].reshape(R, S, GD)
+        # Batched over the tile: contract GD, batch dim R. Operands stay
+        # bf16 — the MXU consumes bf16 natively with f32 accumulation;
+        # f32 inputs run emulated at a fraction of the rate.
+        dims = (((2,), (2,)), ((0,), (0,)))
         logits = jax.lax.dot_general(
-            q.astype(jnp.float32), k.astype(jnp.float32), dims,
-            preferred_element_type=jnp.float32) * scale
-        pos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
-        live = pos < seq_len
-        logits = jnp.where(live, logits, NEG_INF)
+            q, k, dims,
+            preferred_element_type=jnp.float32) * scale   # (R, H, S)
+        H = acc_ref.shape[1]
+        logits = logits + bias_ref[...].reshape(R, H, S).astype(jnp.float32)
 
         m_prev = m_ref[...]
         l_prev = l_ref[...]
@@ -189,31 +264,55 @@ def _fused_kernel(
         l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[...] = m_new
         pv = jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (R, H, GD)
         acc_ref[...] = acc_ref[...] * alpha + pv
+
+        # Drain this pair's writebacks. Placed after the attention math
+        # so the page DMAs overlap it; completing before the step ends
+        # keeps the slot-reuse invariant (see module docstring). The
+        # wait descriptor's page index is irrelevant — only the byte
+        # count (one page) and the semaphore matter.
+        for r in range(R):
+            row = t * R + r
+            cur = seq_lens_ref[row] - 1
+            cur_chunk = (cur // page_size) // ppc
+
+            @pl.when(c == cur_chunk)
+            def _():
+                wp = write_page_ref[row]
+                pltpu.make_async_copy(
+                    k_scratch.at[slot, r, 0, pl.ds(0, 8)],
+                    k_out.at[lyr, wp, pl.ds(0, 8)],
+                    wsem.at[0, r]).wait()
+                pltpu.make_async_copy(
+                    v_scratch.at[slot, r, 0, pl.ds(0, 8)],
+                    v_out.at[lyr, wp, pl.ds(0, 8)],
+                    wsem.at[1, r]).wait()
+
+        state[_CONSUMED] = consumed + 1
 
     @pl.when(c == num_chunks - 1)
     def _():
-        # Zero guard: seq_lens[b] == 0 skips every chunk, leaving l at 0
-        # — emit 0 (matching the other paged kernels) instead of 0/0.
-        out_ref[0] = (acc_ref[...]
-                      / jnp.maximum(l_ref[...], 1e-30)).astype(out_ref.dtype)
+        # Zero guard: a seq_len == 0 row computes no chunk, leaving l at
+        # 0 — emit 0 (matching the other paged kernels) instead of 0/0.
+        out_ref[...] = (acc_ref[...]
+                        / jnp.maximum(l_ref[...], 1e-30)
+                        ).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("pages_per_chunk", "interpret"))
 def fused_decode_attention_pallas(
     q: jnp.ndarray,             # (B, H, D)
-    k_new: jnp.ndarray,         # (B, H_kv, D) — current tokens' K
+    k_new: jnp.ndarray,         # (B, H_kv, D) or (B, H_kv·D)
     v_new: jnp.ndarray,
-    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv·D) FLAT
     v_pool: jnp.ndarray,
     block_tables: jnp.ndarray,  # (B, max_pages) int32
     seq_lens: jnp.ndarray,      # (B,) int32 (pos+1, incl. current)
     write_page: jnp.ndarray,    # (B,) int32 — pool page id to write
     layer: jnp.ndarray | int = 0,
     *,
-    pages_per_chunk: int = 8,
+    pages_per_chunk: int = 0,
     interpret: bool = False,
 ):
     """Fused decode step: write the current tokens' KV into the pool
@@ -223,76 +322,108 @@ def fused_decode_attention_pallas(
     ``write_page`` must equal ``block_tables[b, (seq_lens[b]-1)//ps]``
     for live rows (the engine's invariant) or 0 for inactive rows.
     All live rows' write pages must be distinct.
+
+    ``pages_per_chunk=0`` (default) sizes chunks to ~256 tokens.
     """
     B, H, D = q.shape
-    L, P, page_size, Hkv, _ = k_pool.shape
+    L, P, page_size, GD = k_pool.shape
+    Hkv = GD // D
     max_pages = block_tables.shape[1]
     n_rep = H // Hkv
-    GD = Hkv * D
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
+    R = 8
+    while B % R:
+        R //= 2
+    if pages_per_chunk <= 0:
+        pages_per_chunk = max(1, 256 // page_size)
     ppc = min(pages_per_chunk, max_pages)
     while max_pages % ppc:
         ppc -= 1
+
+    def kv_scratch_bytes(r_, ppc_):
+        return (2 * 2 * r_ * ppc_ * page_size * GD
+                * k_pool.dtype.itemsize)
+
+    # Stay under the ~16 MB scoped-VMEM limit: llama3-8b's GD=1024 at
+    # the default 256-token chunk puts the KV scratch alone at 16.8 MB
+    # for R=8. Shrink the chunk first, then the row tile.
+    while ppc > 1 and kv_scratch_bytes(R, ppc) > 12 * 2**20:
+        ppc = max(1, ppc // 2)
+        while max_pages % ppc:
+            ppc -= 1
+    while R > 1 and kv_scratch_bytes(R, ppc) > 12 * 2**20:
+        R //= 2
+    num_tiles = B // R
     num_chunks = max_pages // ppc
 
     eye = jnp.eye(Hkv, dtype=q.dtype)
     q_bd = jnp.einsum("bgrd,gh->bgrhd", q.reshape(B, Hkv, n_rep, D),
                       eye).reshape(B, H, GD)
-    n_pad = -(-B // 8) * 8
-    kn = jnp.pad(k_new.reshape(B, GD), ((0, n_pad - B), (0, 0))
-                 ).astype(k_pool.dtype)
-    vn = jnp.pad(v_new.reshape(B, GD), ((0, n_pad - B), (0, 0))
-                 ).astype(v_pool.dtype)
+    # Additive mask, chunk-blocked: (B, num_chunks, H, S) with 0 on
+    # positions < seq_len and -1e30 beyond (built here because Mosaic
+    # can't stack SMEM scalars into vectors; H broadcast because the
+    # block's last-two dims must be tile-aligned; bf16 because its
+    # exponent range covers -1e30 at half the HBM traffic).
+    S = ppc * page_size
+    pos_all = (jnp.arange(num_chunks * S, dtype=jnp.int32)
+               .reshape(1, num_chunks, 1, S))
+    bias = jnp.where(pos_all < seq_lens.reshape(B, 1, 1, 1),
+                     0.0, NEG_INF).astype(jnp.bfloat16)
+    bias = jnp.broadcast_to(bias, (B, num_chunks, H, S))
+    kn = k_new.reshape(B, GD).astype(k_pool.dtype)
+    vn = v_new.reshape(B, GD).astype(v_pool.dtype)
 
     kernel = functools.partial(
-        _fused_kernel, pages_per_chunk=ppc, page_size=page_size,
-        num_chunks=num_chunks, scale=D ** -0.5)
+        _fused_kernel, rows_per_tile=R, pages_per_chunk=ppc,
+        page_size=page_size, num_chunks=num_chunks, batch=B,
+        scale=D ** -0.5)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
-        grid=(B, num_chunks),
+        grid=(num_tiles, num_chunks),
         in_specs=[
-            pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
-            pl.BlockSpec((n_pad, GD), lambda b, c, *_: (0, 0)),
-            pl.BlockSpec((n_pad, GD), lambda b, c, *_: (0, 0)),
+            pl.BlockSpec((R, H, GD), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
+            pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
+            pl.BlockSpec((R, 1, H, S), lambda t, c, *_: (t, c, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((R, H, GD), lambda t, c, *_: (t, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.VMEM((H, GD), jnp.float32),
-            pltpu.VMEM((2, ppc, page_size, GD), k_pool.dtype),
-            pltpu.VMEM((2, ppc, page_size, GD), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, ppc)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((R, H, 1), jnp.float32),
+            pltpu.VMEM((R, H, 1), jnp.float32),
+            pltpu.VMEM((R, H, GD), jnp.float32),
+            pltpu.VMEM((2, R, ppc, page_size, GD), k_pool.dtype),
+            pltpu.VMEM((2, R, ppc, page_size, GD), v_pool.dtype),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, R)),
         ],
     )
-    kf = k_pool.reshape(L, P, page_size, GD)
-    vf = v_pool.reshape(L, P, page_size, GD)
-    # Operands: 4 scalar-prefetch, then q_bd, kn, vn, kf, vf → pool
-    # operands 7/8 alias outputs 1/2.
+    # Operands: 4 scalar-prefetch, then q_bd, kn, vn, bias, pools →
+    # pool operands 8/9 alias outputs 1/2. Pools are ALREADY flat
+    # (L, P, ps, GD) — any reshape here would break XLA's aliasing and
+    # copy both pools every call (see init_kv_pages).
     out, k_out, v_out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((B, H, GD), q.dtype),
-                   jax.ShapeDtypeStruct(kf.shape, kf.dtype),
-                   jax.ShapeDtypeStruct(vf.shape, vf.dtype)],
-        input_output_aliases={7: 1, 8: 2},
+                   jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
+        input_output_aliases={8: 1, 9: 2},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       write_page.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
-      q_bd, kn, vn, kf, vf)
+      q_bd, kn, vn, bias, k_pool, v_pool)
     out5 = out.reshape(B, Hkv, n_rep, Hkv, D)
     attn = jnp.einsum("bgrhd,gh->bgrd", out5,
                       jnp.eye(Hkv, dtype=out.dtype)).reshape(B, H, D)
-    return attn.astype(q.dtype), (k_out.reshape(L, P, page_size, Hkv, D),
-                                  v_out.reshape(L, P, page_size, Hkv, D))
+    return attn.astype(q.dtype), (k_out, v_out)

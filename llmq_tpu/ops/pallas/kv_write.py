@@ -121,11 +121,10 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def kv_cache_write_pallas(
-    k_pool: jnp.ndarray,      # (L, P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,      # (L, P, page_size, H_kv·D) FLAT
     v_pool: jnp.ndarray,
-    k_new: jnp.ndarray,       # (N, H_kv, D) — one DISTINCT page per row
+    k_new: jnp.ndarray,       # (N, H_kv·D) — one DISTINCT page per row
     v_new: jnp.ndarray,
     page_of: jnp.ndarray,     # (N,) int32
     slot_of: jnp.ndarray,     # (N,) int32
@@ -135,9 +134,8 @@ def kv_cache_write_pallas(
 ):
     """Write N token rows (distinct pages!) into the pool in place.
     Returns the updated (k_pool, v_pool) — the same buffers, aliased."""
-    L, P, page_size, Hkv, D = k_pool.shape
+    L, P, page_size, GD = k_pool.shape
     N = k_new.shape[0]
-    GD = Hkv * D
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
 
@@ -163,8 +161,6 @@ def kv_cache_write_pallas(
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    kf = k_pool.reshape(L, P, page_size, GD)
-    vf = v_pool.reshape(L, P, page_size, GD)
     kn = jnp.pad(k_new.reshape(N, GD), ((0, n_pad - N), (0, 0))
                  ).astype(k_pool.dtype)
     vn = jnp.pad(v_new.reshape(N, GD), ((0, n_pad - N), (0, 0))
@@ -174,17 +170,16 @@ def kv_cache_write_pallas(
     k_out, v_out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct(kf.shape, kf.dtype),
-                   jax.ShapeDtypeStruct(vf.shape, vf.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
         input_output_aliases={5: 0, 6: 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(page_of.astype(jnp.int32), slot_of.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
-      kn, vn, kf, vf)
-    return (k_out.reshape(L, P, page_size, Hkv, D),
-            v_out.reshape(L, P, page_size, Hkv, D))
+      kn, vn, k_pool, v_pool)
+    return (k_out, v_out)
 
 
 def _kv_prefill_kernel(
@@ -294,11 +289,10 @@ def _kv_prefill_kernel(
                 v_out.at[lyr, pid], sem.at[1, j]).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def kv_prefill_write_pallas(
-    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,       # (L, P, page_size, H_kv·D) FLAT
     v_pool: jnp.ndarray,
-    k_aligned: jnp.ndarray,    # (n_wp·page_size, H_kv, D), page-aligned
+    k_aligned: jnp.ndarray,    # (n_wp·page_size, H_kv·D), page-aligned
     v_aligned: jnp.ndarray,
     block_table: jnp.ndarray,  # (max_pages,) int32
     start_pos: jnp.ndarray,    # scalar int32 — absolute pos of token 0
@@ -313,8 +307,7 @@ def kv_prefill_write_pallas(
     (leading rows are don't-care) — one contiguous dynamic-update-slice
     for the caller, static page-block slicing for the kernel.
     """
-    L, P, page_size, Hkv, D = k_pool.shape
-    GD = Hkv * D
+    L, P, page_size, GD = k_pool.shape
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
     n_wp = k_aligned.shape[0] // page_size
@@ -345,13 +338,11 @@ def kv_prefill_write_pallas(
     meta = jnp.stack([jnp.asarray(start_pos, jnp.int32),
                       jnp.asarray(n_tokens, jnp.int32),
                       jnp.asarray(layer, jnp.int32)])
-    kf = k_pool.reshape(L, P, page_size, GD)
-    vf = v_pool.reshape(L, P, page_size, GD)
     k_out, v_out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct(kf.shape, kf.dtype),
-                   jax.ShapeDtypeStruct(vf.shape, vf.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
         input_output_aliases={4: 0, 5: 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
@@ -359,6 +350,5 @@ def kv_prefill_write_pallas(
     )(block_table.astype(jnp.int32), meta,
       k_aligned.reshape(-1, GD).astype(k_pool.dtype),
       v_aligned.reshape(-1, GD).astype(v_pool.dtype),
-      kf, vf)
-    return (k_out.reshape(L, P, page_size, Hkv, D),
-            v_out.reshape(L, P, page_size, Hkv, D))
+      k_pool, v_pool)
+    return (k_out, v_out)

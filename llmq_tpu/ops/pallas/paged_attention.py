@@ -188,11 +188,10 @@ def _decode_kernel(
                       / jnp.maximum(l_ref[...], 1e-30)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("pages_per_chunk", "interpret"))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,             # (B, H, D)
-    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv, D) or (P, ps, H_kv, D)
-    v_pool: jnp.ndarray,        # same shape as k_pool
+    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv·D) or (P, ps, H_kv·D)
+    v_pool: jnp.ndarray,        # same shape as k_pool (FLAT head dim)
     block_tables: jnp.ndarray,  # (B, max_pages) int32
     seq_lens: jnp.ndarray,      # (B,) int32
     layer: jnp.ndarray | int = 0,  # scalar int32 — pool layer to read
@@ -213,14 +212,14 @@ def paged_decode_attention_pallas(
     layer loop passes each static layer index straight through while
     threading one pool buffer across all layers.
     """
-    if k_pool.ndim == 4:                 # single-layer convenience form
+    if k_pool.ndim == 3:                 # single-layer convenience form
         k_pool = k_pool[None]
         v_pool = v_pool[None]
     B, H, D = q.shape
-    L, P, page_size, Hkv, _ = k_pool.shape
+    L, P, page_size, GD = k_pool.shape
+    Hkv = GD // D
     max_pages = block_tables.shape[1]
     n_rep = H // Hkv
-    GD = Hkv * D
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
     ppc = min(pages_per_chunk, max_pages)
@@ -233,8 +232,6 @@ def paged_decode_attention_pallas(
     eye = jnp.eye(Hkv, dtype=q.dtype)                      # (g, g')
     q_bd = jnp.einsum("bgrd,gh->bgrhd", q.reshape(B, Hkv, n_rep, D),
                       eye).reshape(B, H, GD)
-    k_flat = k_pool.reshape(L, P, page_size, GD)
-    v_flat = v_pool.reshape(L, P, page_size, GD)
 
     kernel = functools.partial(
         _decode_kernel,
@@ -270,7 +267,7 @@ def paged_decode_attention_pallas(
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
-      q_bd, k_flat, v_flat)
+      q_bd, k_pool, v_pool)
     # Extract each row's diagonal block: (B, H, GD) → (B, H, D).
     out5 = out.reshape(B, Hkv, n_rep, Hkv, D)
     res = jnp.einsum("bgrhd,gh->bgrd", out5, jnp.eye(Hkv, dtype=out.dtype))

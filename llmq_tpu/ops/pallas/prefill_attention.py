@@ -162,12 +162,9 @@ def _prefill_attn_kernel(
                         ).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("pages_per_chunk", "q_block",
-                                    "interpret"))
 def paged_prefill_attention_pallas(
     q: jnp.ndarray,             # (T, H, D) — ONE sequence's chunk
-    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv, D)
+    k_pool: jnp.ndarray,        # (L, P, page_size, H_kv·D) FLAT
     v_pool: jnp.ndarray,
     block_table: jnp.ndarray,   # (max_pages,) int32
     start_pos: jnp.ndarray,     # scalar int32 — absolute pos of q row 0
@@ -184,19 +181,38 @@ def paged_prefill_attention_pallas(
     and T % q_block == 0 (the executor's buckets are powers of two).
     """
     T, H, D = q.shape
-    L, P, page_size, Hkv, _ = k_pool.shape
+    L, P, page_size, GD = k_pool.shape
+    Hkv = GD // D
     max_pages = block_table.shape[0]
     n_rep = H // Hkv
-    GD = Hkv * D
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
     qb = min(q_block, T)
     while T % qb:
         qb -= 1
-    n_qb = T // qb
     ppc = min(pages_per_chunk, max_pages)
     while max_pages % ppc:
         ppc -= 1
+
+    def vmem_est(qb_, ppc_):
+        # f32 acc/m/l + double-buffered KV scratch + q/out blocks.
+        acc = qb_ * H * (GD + 2) * 4
+        kv = 2 * 2 * ppc_ * page_size * GD * k_pool.dtype.itemsize
+        qo = 2 * qb_ * H * GD * q.dtype.itemsize
+        return acc + kv + qo
+
+    # Stay under the ~16 MB VMEM scoped limit with headroom: shrink the
+    # KV chunk first (large pages made the default 8-page chunk 2 MB+
+    # per buffer), then the q block.
+    while ppc > 1 and vmem_est(qb, ppc) > 12 * 2**20:
+        ppc = max(1, ppc // 2)
+        while max_pages % ppc:
+            ppc -= 1
+    while qb > 8 and vmem_est(qb, ppc) > 12 * 2**20:
+        qb //= 2
+        while T % qb:
+            qb -= 1
+    n_qb = T // qb
     num_chunks = max_pages // ppc
 
     # Block-diagonal q rows: row (t, h) carries q[t, h] in group block.
@@ -242,8 +258,7 @@ def paged_prefill_attention_pallas(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_table.astype(jnp.int32), meta,
-      q_bd, k_pool.reshape(L, P, page_size, GD),
-      v_pool.reshape(L, P, page_size, GD))
+      q_bd, k_pool, v_pool)
     # Extract each row's diagonal block: (T·H, GD) → (T, H, D).
     out5 = out.reshape(T, Hkv, n_rep, Hkv, D)
     res = jnp.einsum("tgrhd,gh->tgrd", out5, jnp.eye(Hkv, dtype=out.dtype))

@@ -44,8 +44,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Params:
-    """NamedSharding pytree congruent with ``init_params``'s layout."""
+def param_shardings(cfg: LlamaConfig, mesh: Mesh,
+                    quantized: bool = False) -> Params:
+    """NamedSharding pytree congruent with ``init_params``'s layout.
+
+    With ``quantized=True`` the tree matches ``ops/quant.quantize_params``
+    output: each matmul leaf becomes ``{"q": <same spec as the bf16
+    weight>, "s": <weight spec with the contraction axis unsharded —
+    it is size 1 in the scale>}``.
+    """
     hd = cfg.head_dim
     tp_q = _axis(mesh, "tp", cfg.n_heads * hd)
     tp_kv = _axis(mesh, "tp", cfg.n_kv_heads * hd)
@@ -55,30 +62,44 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Params:
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    def mm(*spec, contract: int = -2):
+        """Matmul-weight leaf: plain spec, or {q, s} pair when quantized."""
+        w = ns(*spec)
+        if not quantized:
+            return w
+        sspec = list(spec)
+        sspec[contract] = None  # scale keeps the contraction dim as 1
+        return {"q": w, "s": ns(*sspec)}
+
     out: Params = {
-        "embed": ns(tp_v, None),
+        # embedding scales are per ROW (V, 1): vocab axis sharded, last None.
+        "embed": ({"q": ns(tp_v, None), "s": ns(tp_v, None)}
+                  if quantized else ns(tp_v, None)),
         "layers": {
-            "wq": ns(None, None, tp_q),
-            "wk": ns(None, None, tp_kv),
-            "wv": ns(None, None, tp_kv),
-            "wo": ns(None, tp_q, None),
-            "w_gate": ns(None, None, tp_f),
-            "w_up": ns(None, None, tp_f),
-            "w_down": ns(None, tp_f, None),
+            "wq": mm(None, None, tp_q),
+            "wk": mm(None, None, tp_kv),
+            "wv": mm(None, None, tp_kv),
+            "wo": mm(None, tp_q, None),
+            "w_gate": mm(None, None, tp_f),
+            "w_up": mm(None, None, tp_f),
+            "w_down": mm(None, tp_f, None),
             "attn_norm": ns(None, None),
             "mlp_norm": ns(None, None),
         },
         "final_norm": ns(None),
     }
     if not cfg.tie_embeddings:
-        out["lm_head"] = ns(None, tp_v)
+        out["lm_head"] = mm(None, tp_v)
     return out
 
 
 def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict[str, NamedSharding]:
-    """(L, P, page_size, H_kv, head_dim) — shard the KV-head dim on tp."""
+    """(L, P, page_size, H_kv·head_dim) — shard the flat KV-head·dim axis
+    on tp. Contiguous chunks of the flat axis are whole KV heads (the
+    flat axis is H_kv-major), so partitioning it by tp when tp divides
+    H_kv is exactly the KV-head sharding of the 5-D layout."""
     tp_kv = _axis(mesh, "tp", cfg.n_kv_heads)
-    ns = NamedSharding(mesh, P(None, None, None, tp_kv, None))
+    ns = NamedSharding(mesh, P(None, None, None, tp_kv))
     return {"k": ns, "v": ns}
 
 

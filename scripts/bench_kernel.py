@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Micro-bench the fused decode kernel alone on the chip (dev tool)."""
+import os
+import sys
+import time
+from functools import partial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.ops.pallas.fused_decode import fused_decode_attention_pallas
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+seq = int(sys.argv[2]) if len(sys.argv) > 2 else 160
+page_size = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+max_seq = int(sys.argv[4]) if len(sys.argv) > 4 else 1024
+reps = 20  # kernel calls fused into one jit program
+
+L, Hkv, D, H = 16, 8, 64, 32  # llama3-1b shapes
+max_pages = max_seq // page_size
+P = B * max_pages + 1
+
+rng = np.random.default_rng(0)
+k_pool = jnp.asarray(rng.standard_normal((L, P, page_size, Hkv * D)),
+                     jnp.bfloat16)
+v_pool = jnp.asarray(rng.standard_normal((L, P, page_size, Hkv * D)),
+                     jnp.bfloat16)
+bt = np.zeros((B, max_pages), np.int32)
+pid = 1
+for b in range(B):
+    for j in range(max_pages):
+        bt[b, j] = pid
+        pid += 1
+bt = jnp.asarray(bt)
+seq_lens = jnp.full((B,), seq, jnp.int32)
+write_page = bt[jnp.arange(B), (seq - 1) // page_size]
+q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+kn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.bfloat16)
+vn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.bfloat16)
+
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def many(q, k_pool, v_pool):
+    outs = []
+    for i in range(reps):
+        attn, (k_pool, v_pool) = fused_decode_attention_pallas(
+            q, kn, vn, k_pool, v_pool, bt, seq_lens, write_page,
+            jnp.int32(i % L))
+        outs.append(jnp.sum(attn))
+    return jnp.stack(outs), k_pool, v_pool
+
+
+outs, k_pool, v_pool = many(q, k_pool, v_pool)
+jax.block_until_ready(outs)
+t0 = time.perf_counter()
+n = 3
+for _ in range(n):
+    outs, k_pool, v_pool = many(q, k_pool, v_pool)
+jax.block_until_ready(outs)
+dt = time.perf_counter() - t0
+per_call_us = dt / (n * reps) * 1e6
+print(f"B={B} seq={seq} ps={page_size} ctx={max_seq}: "
+      f"{per_call_us:,.0f} us/kernel-call  "
+      f"({per_call_us/B:,.2f} us/row)", flush=True)

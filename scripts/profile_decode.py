@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Bisect the decode step: time scan-of-K variants with components
+knocked out to find where the ms go (dev tool).
+
+Variants:
+  full       — forward_decode as served (pallas fused attention)
+  nosample   — greedy argmax instead of sample_token
+  noattn     — attention+KV-write replaced by a cheap elementwise mix
+  nohead     — no lm_head projection (last-layer h reduced directly)
+  attnonly   — attention/KV only, single trivial matmul per layer
+  purejax    — LLMQ_PALLAS=0 route (gather + einsum attention)
+"""
+import os
+import sys
+import time
+from functools import partial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.kv_allocator import PageAllocator
+from llmq_tpu.models.llama import get_config, init_params, init_kv_pages
+from llmq_tpu.ops.attention import paged_decode_step
+from llmq_tpu.ops.norms import rms_norm
+from llmq_tpu.ops.quant import layer_slice, linear
+from llmq_tpu.ops.rope import apply_rope, rope_cos_sin
+from llmq_tpu.ops.sampling import sample_token
+
+model = sys.argv[1] if len(sys.argv) > 1 else "llama3-1b"
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+K = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+max_seq = 1024
+
+cfg = get_config(model, max_seq_len=max_seq)
+params = init_params(jax.random.PRNGKey(0), cfg)
+page_size = 16
+pages_per_seq = max_seq // page_size
+num_pages = batch * pages_per_seq + 1
+alloc = PageAllocator(num_pages, page_size)
+bt = np.zeros((batch, max_seq // page_size), np.int32)
+for b in range(batch):
+    bt[b, :pages_per_seq] = alloc.alloc(pages_per_seq)
+bt = jnp.asarray(bt)
+
+
+def step_body(p, c, tok, pos, *, attn_mode="full", head=True, samp=True):
+    B = tok.shape[0]
+    page_sz = c["k"].shape[2]
+    h = p["embed"][tok].astype(cfg.dtype)
+    cos, sin = rope_cos_sin(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    page_of = bt[jnp.arange(B), pos // page_sz]
+    slot_of = pos % page_sz
+    seq_lens = pos + 1
+    lp = p["layers"]
+    k_pool, v_pool = c["k"], c["v"]
+    for l in range(cfg.n_layers):
+        hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
+        if attn_mode == "attnonly":
+            qkv = linear(hn, layer_slice(lp["wk"], l))
+            q = jnp.broadcast_to(
+                qkv.reshape(B, 1, cfg.n_kv_heads, cfg.head_dim),
+                (B, 1, cfg.n_heads, cfg.head_dim))
+            k = qkv.reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+            v = k
+        else:
+            q = linear(hn, layer_slice(lp["wq"], l)).reshape(
+                B, 1, cfg.n_heads, cfg.head_dim)
+            k = linear(hn, layer_slice(lp["wk"], l)).reshape(
+                B, 1, cfg.n_kv_heads, cfg.head_dim)
+            v = linear(hn, layer_slice(lp["wv"], l)).reshape(
+                B, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)[:, 0]
+        k = apply_rope(k, cos, sin)[:, 0]
+        v = v[:, 0]
+        if attn_mode == "noattn":
+            attn = q * 0.5 + jnp.repeat(k, cfg.n_heads // cfg.n_kv_heads, 1)
+        else:
+            attn, k_pool, v_pool = paged_decode_step(
+                q, k, v, k_pool, v_pool, bt, seq_lens, page_of, slot_of,
+                jnp.int32(l))
+        if attn_mode == "attnonly":
+            h = h + jnp.mean(attn.reshape(B, -1), -1, keepdims=True)
+        else:
+            h = h + linear(attn.reshape(B, -1), layer_slice(lp["wo"], l))
+            hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
+            g = linear(hn2, layer_slice(lp["w_gate"], l))
+            u = linear(hn2, layer_slice(lp["w_up"], l))
+            h = h + linear(jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype) * u,
+                           layer_slice(lp["w_down"], l))
+    h = rms_norm(h, p["final_norm"], cfg.norm_eps)
+    if head:
+        logits = jnp.dot(h, p["embed"].T).astype(jnp.float32)
+    else:
+        logits = jnp.broadcast_to(
+            jnp.sum(h, -1, keepdims=True).astype(jnp.float32),
+            (B, cfg.vocab_size))
+    return logits, {"k": k_pool, "v": v_pool}
+
+
+def make_chunk(attn_mode="full", head=True, samp=True):
+    @partial(jax.jit, donate_argnums=(1,))
+    def chunk(p, c, tok, pos, key):
+        def body(carry, key_j):
+            c, tok, pos = carry
+            logits, c = step_body(p, c, tok, pos, attn_mode=attn_mode,
+                                  head=head, samp=samp)
+            if samp:
+                nxt = sample_token(logits, key_j, temperature=jnp.zeros(tok.shape[0]))
+            else:
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (c, nxt, pos + 1), nxt
+        keys = jax.random.split(key, K)
+        (c, tok, pos), outs = jax.lax.scan(body, (c, tok, pos), keys)
+        return outs.T, c
+    return chunk
+
+
+tok0 = jnp.asarray(np.random.default_rng(0).integers(10, cfg.vocab_size - 10,
+                                                     batch), jnp.int32)
+pos0 = jnp.full((batch,), 128, jnp.int32)
+key = jax.random.PRNGKey(0)
+
+variants = [
+    ("full", dict(attn_mode="full", head=True, samp=True)),
+    ("nosample", dict(attn_mode="full", head=True, samp=False)),
+    ("nohead", dict(attn_mode="full", head=False, samp=False)),
+    ("noattn", dict(attn_mode="noattn", head=True, samp=True)),
+    ("attnonly", dict(attn_mode="attnonly", head=False, samp=False)),
+]
+if os.environ.get("LLMQ_PALLAS") == "0":
+    variants = [("purejax-" + n, kw) for n, kw in variants]
+
+for name, kw in variants:
+    fn = make_chunk(**kw)
+    c = init_kv_pages(cfg, num_pages, page_size)
+    t0 = time.perf_counter()
+    out, c = fn(params, c, tok0, pos0, key)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    n_calls = 4
+    for i in range(n_calls):
+        out, c = fn(params, c, tok0, pos0, key)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    ms = dt / (n_calls * K) * 1e3
+    print(f"{name:12s} {ms:7.2f} ms/step   (compile {compile_s:.0f}s)",
+          flush=True)
+    del c

@@ -32,6 +32,16 @@ def _paged_setup(rng, *, B=4, H=8, Hkv=2, D=64, ps=16, P=32, mp=6,
     return q, k, v, bt
 
 
+def _flat(pool):
+    """Kernel-layout view: (P, ps, H_kv, D) → flat (P, ps, H_kv·D)."""
+    return pool.reshape(pool.shape[0], pool.shape[1], -1)
+
+
+def _flat2(pool):
+    """Stacked-pool view: (L, P, ps, H_kv, D) → (L, P, ps, H_kv·D)."""
+    return pool.reshape(*pool.shape[:3], -1)
+
+
 class TestPagedDecodeKernel:
     def test_matches_reference(self):
         rng = np.random.default_rng(0)
@@ -39,7 +49,7 @@ class TestPagedDecodeKernel:
         # Lengths hit: single token, mid-page, page boundary, full window.
         sl = jnp.asarray([1, 17, 32, 96], jnp.int32)
         ref = paged_decode_attention(q, k, v, bt, sl)
-        out = paged_decode_attention_pallas(q, k, v, bt, sl,
+        out = paged_decode_attention_pallas(q, _flat(k), _flat(v), bt, sl,
                                             pages_per_chunk=2,
                                             interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -50,8 +60,7 @@ class TestPagedDecodeKernel:
         q, k, v, bt = _paged_setup(rng, dtype=jnp.bfloat16)
         sl = jnp.asarray([5, 40, 96, 64], jnp.int32)
         ref = paged_decode_attention(q, k, v, bt, sl).astype(jnp.float32)
-        out = paged_decode_attention_pallas(
-            q, k, v, bt, sl, pages_per_chunk=4,
+        out = paged_decode_attention_pallas(q, _flat(k), _flat(v), bt, sl, pages_per_chunk=4,
             interpret=True).astype(jnp.float32)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=5e-2, rtol=5e-2)
@@ -60,11 +69,11 @@ class TestPagedDecodeKernel:
         rng = np.random.default_rng(2)
         q, k, v, bt = _paged_setup(rng)
         sl = jnp.asarray([9, 25, 50, 80], jnp.int32)
-        a = paged_decode_attention_pallas(q, k, v, bt, sl,
+        a = paged_decode_attention_pallas(q, _flat(k), _flat(v), bt, sl,
                                           pages_per_chunk=1, interpret=True)
-        b = paged_decode_attention_pallas(q, k, v, bt, sl,
+        b = paged_decode_attention_pallas(q, _flat(k), _flat(v), bt, sl,
                                           pages_per_chunk=3, interpret=True)
-        c = paged_decode_attention_pallas(q, k, v, bt, sl,
+        c = paged_decode_attention_pallas(q, _flat(k), _flat(v), bt, sl,
                                           pages_per_chunk=6, interpret=True)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-2, rtol=2e-2)
@@ -86,7 +95,8 @@ class TestPagedDecodeKernel:
                 k_np[dead] = np.nan
                 v_np[dead] = np.nan
         out = paged_decode_attention_pallas(
-            jnp.asarray(q), jnp.asarray(k_np), jnp.asarray(v_np), bt, sl,
+            jnp.asarray(q), _flat(jnp.asarray(k_np)),
+            _flat(jnp.asarray(v_np)), bt, sl,
             pages_per_chunk=2, interpret=True)
         assert np.isfinite(np.asarray(out)).all()
 
@@ -126,9 +136,11 @@ class TestKvWriteKernels:
         vn = jnp.asarray(rng.standard_normal((N, Hkv, D)), jnp.float32)
         page = jnp.asarray(np.arange(1, N + 1), jnp.int32)   # distinct
         slot = jnp.asarray(np.arange(N) % ps, jnp.int32)
-        ref_k = k.at[1, page, slot].set(kn)
-        ref_v = v.at[1, page, slot].set(vn)
-        ok, ov = kv_cache_write_pallas(k, v, kn, vn, page, slot, 1,
+        kf, vf = _flat2(k), _flat2(v)
+        ref_k = kf.at[1, page, slot].set(kn.reshape(N, -1))
+        ref_v = vf.at[1, page, slot].set(vn.reshape(N, -1))
+        ok, ov = kv_cache_write_pallas(kf, vf, kn.reshape(N, -1),
+                                       vn.reshape(N, -1), page, slot, 1,
                                        interpret=True)
         np.testing.assert_array_equal(np.asarray(ok), np.asarray(ref_k))
         np.testing.assert_array_equal(np.asarray(ov), np.asarray(ref_v))
@@ -151,18 +163,19 @@ class TestKvWriteKernels:
         pos = start + np.arange(n_tok)
         page = np.asarray(bt)[pos // ps]
         slot = pos % ps
-        ref_k = k.at[1, page, slot].set(kn)
-        ref_v = v.at[1, page, slot].set(vn)
+        kf, vf = _flat2(k), _flat2(v)
+        ref_k = kf.at[1, page, slot].set(kn.reshape(n_tok, -1))
+        ref_v = vf.at[1, page, slot].set(vn.reshape(n_tok, -1))
         # kernel: page-aligned buffer, bucket length T >= n_tok
         T = 32
         n_wp = T // ps + 1
-        ak = np.zeros((n_wp * ps, Hkv, D), np.float32)
-        av = np.zeros((n_wp * ps, Hkv, D), np.float32)
+        ak = np.zeros((n_wp * ps, Hkv * D), np.float32)
+        av = np.zeros((n_wp * ps, Hkv * D), np.float32)
         off = start % ps
-        ak[off:off + n_tok] = kn
-        av[off:off + n_tok] = vn
+        ak[off:off + n_tok] = np.asarray(kn).reshape(n_tok, -1)
+        av[off:off + n_tok] = np.asarray(vn).reshape(n_tok, -1)
         ok, ov = kv_prefill_write_pallas(
-            k, v, jnp.asarray(ak), jnp.asarray(av), bt,
+            kf, vf, jnp.asarray(ak), jnp.asarray(av), bt,
             jnp.int32(start), jnp.int32(n_tok), 1, interpret=True)
         np.testing.assert_array_equal(np.asarray(ok), np.asarray(ref_k))
         np.testing.assert_array_equal(np.asarray(ov), np.asarray(ref_v))
@@ -176,9 +189,9 @@ class TestKvWriteKernels:
         L, P, ps, Hkv, D = 2, 16, 16, 2, 64
         T, start, n_tok = 24, 28, 24         # off=12, off+T=36 > 2*ps
         mp = 8
-        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
-        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
         bt = jnp.asarray(np.arange(1, mp + 1), jnp.int32)[None]
         k = jnp.asarray(rng.standard_normal((1, T, Hkv, D)), jnp.float32)
@@ -241,9 +254,9 @@ class TestFusedDecode:
         rng = np.random.default_rng(3)
         L, P, ps, Hkv, D, H, B = 2, 24, 8, 2, 64, 4, 3
         mp = 6
-        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
-        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
         bt = jnp.asarray(
             rng.permutation(np.arange(1, P))[:B * mp].reshape(B, mp),
@@ -266,6 +279,56 @@ class TestFusedDecode:
         np.testing.assert_array_equal(np.asarray(ok), np.asarray(rk))
         np.testing.assert_array_equal(np.asarray(ov), np.asarray(rv))
 
+    def test_full_row_tile_mixed_lengths(self, monkeypatch):
+        """B=8 exercises the real R=8 tile path (cross-pair prefetch
+        chain, SMEM slot parity, per-row merge in a shared tile) with
+        wildly mixed seq_lens including zero — B=3 degenerates to R=1
+        and would leave all of that untested."""
+        from llmq_tpu.ops.pallas.fused_decode import (
+            fused_decode_attention_pallas)
+        from llmq_tpu.ops.attention import (paged_decode_attention_pooled,
+                                            paged_kv_write)
+        monkeypatch.setenv("LLMQ_PALLAS", "0")   # pure reference path
+        rng = np.random.default_rng(11)
+        L, P, ps, Hkv, D, H, B = 2, 80, 8, 2, 64, 4, 8
+        mp = 8
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
+                             jnp.float32)
+        bt = jnp.asarray(
+            rng.permutation(np.arange(1, P))[:B * mp].reshape(B, mp),
+            jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        kn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+        # page edges, full window, and a zero-length (inactive) row
+        seq_lens = jnp.asarray([1, 8, 9, 0, 64, 33, 16, 57], jnp.int32)
+        positions = jnp.maximum(seq_lens - 1, 0)
+        live = seq_lens > 0
+        page_of = jnp.where(live, bt[jnp.arange(B), positions // ps], 0)
+        slot_of = positions % ps
+        kn_w = jnp.where(live[:, None, None], kn, 0)
+        vn_w = jnp.where(live[:, None, None], vn, 0)
+        rk, rv = paged_kv_write(k_pool, v_pool, kn_w, vn_w, page_of,
+                                slot_of, 1)
+        ref = paged_decode_attention_pooled(q, rk, rv, bt, seq_lens, 1)
+        attn, (ok, ov) = fused_decode_attention_pallas(
+            q, kn, vn, k_pool, v_pool, bt, seq_lens, page_of, 1,
+            pages_per_chunk=2, interpret=True)
+        a, r = np.asarray(attn), np.asarray(ref)
+        mask = np.asarray(live)
+        np.testing.assert_allclose(a[mask], r[mask], atol=3e-2, rtol=3e-2)
+        # zero-length row emits exactly 0 (the documented contract)
+        assert np.all(a[~mask] == 0)
+        # pools: live rows' pages updated; the seq-0 row wrote nothing
+        # except possibly reserved page 0 (never read) — compare all
+        # non-reserved pages.
+        np.testing.assert_array_equal(np.asarray(ok)[:, 1:],
+                                      np.asarray(rk)[:, 1:])
+        np.testing.assert_array_equal(np.asarray(ov)[:, 1:],
+                                      np.asarray(rv)[:, 1:])
+
 
 class TestPrefillAttentionKernel:
     @pytest.mark.parametrize("start", [0, 24])
@@ -277,9 +340,9 @@ class TestPrefillAttentionKernel:
         rng = np.random.default_rng(start)
         L, P, ps, Hkv, D, H = 2, 24, 8, 2, 64, 4
         T, mp = 16, 8
-        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        k_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
-        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv, D)),
+        v_pool = jnp.asarray(rng.standard_normal((L, P, ps, Hkv * D)),
                              jnp.float32)
         bt = jnp.asarray(rng.permutation(np.arange(1, P))[:mp], jnp.int32)
         q = jnp.asarray(rng.standard_normal((1, T, H, D)), jnp.float32)
@@ -288,6 +351,7 @@ class TestPrefillAttentionKernel:
 
         k_hist = k_pool[1, bt[None]].reshape(1, mp * ps, Hkv, D)
         v_hist = v_pool[1, bt[None]].reshape(1, mp * ps, Hkv, D)
+        # (gathered VALUES may be unflattened freely; the pool may not)
         ref = blockwise_prefill_attention(q, k_hist, v_hist, positions,
                                           seq_lens)
         out = paged_prefill_attention_pallas(

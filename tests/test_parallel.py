@@ -105,7 +105,7 @@ class TestTPCorrectness:
             P(None, None, "tp")
         # KV-head axis (2) does not divide 8 → cache replicated.
         assert kv_cache_shardings(tiny, mesh)["k"].spec == \
-            P(None, None, None, None, None)
+            P(None, None, None, None)
 
 
 class TestDPTrainStep:
