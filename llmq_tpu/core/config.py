@@ -76,6 +76,12 @@ class WorkerConfig:
     max_batch_size: int = 10
     process_interval: float = 0.1
     max_concurrent: int = 50
+    # Hard per-message deadline enforcement (reference worker.go:166
+    # context.WithTimeout semantics): a process function that wedges past
+    # message.timeout is abandoned by the watchdog — its slot is freed
+    # and the message takes the timeout/retry path. The wedged call keeps
+    # running on its (daemon) thread; Python cannot kill it.
+    hard_deadline: bool = True
 
 
 @dataclass

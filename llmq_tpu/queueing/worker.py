@@ -29,6 +29,7 @@ Fixes over the reference (SURVEY.md #5-#7):
 
 from __future__ import annotations
 
+import itertools
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -71,6 +72,32 @@ class ProcessContext:
 
 
 ProcessFn = Callable[[ProcessContext, Message], None]
+
+
+class _Inflight:
+    """One dispatched message, shared between the processing thread and
+    the watchdog. ``claim()`` arbitrates who owns the outcome: the
+    processing thread claims on return, the watchdog claims at the hard
+    deadline — exactly one side wins and handles completion/failure and
+    the semaphore slot."""
+
+    __slots__ = ("msg", "ctx", "start", "deadline", "_claimed", "_mu")
+
+    def __init__(self, msg: Message, ctx: ProcessContext, start: float,
+                 deadline: float) -> None:
+        self.msg = msg
+        self.ctx = ctx
+        self.start = start
+        self.deadline = deadline
+        self._claimed = False
+        self._mu = threading.Lock()
+
+    def claim(self) -> bool:
+        with self._mu:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
 
 
 class BackoffStrategy:
@@ -170,6 +197,10 @@ class Worker:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._inflight: Dict[int, _Inflight] = {}
+        self._inflight_mu = threading.Lock()
+        self._inflight_seq = itertools.count()
 
     def _backoff_from_config(self) -> BackoffStrategy:
         r = self.rconfig
@@ -191,12 +222,20 @@ class Worker:
         self._thread = threading.Thread(
             target=self._process_loop, name=f"worker-loop-{self.name}", daemon=True)
         self._thread.start()
+        if self.wconfig.hard_deadline:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name=f"worker-watchdog-{self.name}", daemon=True)
+            self._watchdog.start()
 
     def stop(self, wait: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
         pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=wait)
@@ -245,20 +284,49 @@ class Worker:
         self._run_one(msg)
 
     def _run_one(self, msg: Message) -> None:
+        release = True
         try:
-            self._process_message(msg)
+            release = self._process_message(msg)
         finally:
-            self._sem.release()
+            if release:
+                # False → the watchdog already freed this slot when it
+                # abandoned the (then-wedged) call.
+                self._sem.release()
 
-    def _process_message(self, msg: Message) -> None:
+    def _process_message(self, msg: Message) -> bool:
+        """Process one message. Returns True if the caller must release
+        the concurrency slot (False when the watchdog already did)."""
         start = self._clock.now()
         deadline = start + msg.timeout if msg.timeout and msg.timeout > 0 else None
         ctx = ProcessContext(deadline, self._clock)
+        rec: Optional[_Inflight] = None
+        token = -1
+        if deadline is not None and self._watchdog is not None:
+            rec = _Inflight(msg, ctx, start, deadline)
+            token = next(self._inflight_seq)
+            with self._inflight_mu:
+                self._inflight[token] = rec
         err: Optional[BaseException] = None
         try:
             self.process_fn(ctx, msg)
         except BaseException as e:  # noqa: BLE001 — any failure enters retry path
             err = e
+        if rec is not None:
+            with self._inflight_mu:
+                self._inflight.pop(token, None)
+            if not rec.claim():
+                # The watchdog declared this call wedged, failed the
+                # message and freed the slot while we were still running.
+                # The work's outcome is discarded: completing now could
+                # double-deliver a message the retry path already
+                # re-queued (reference context.WithTimeout semantics —
+                # there the goroutine's late result is dropped the same
+                # way).
+                log.warning(
+                    "message %s returned %.3fs after its watchdog "
+                    "abandonment; result dropped",
+                    msg.id, self._clock.now() - rec.deadline)
+                return False
         elapsed = self._clock.now() - start
         timed_out = ctx.expired()
         with self.stats._mu:
@@ -270,14 +338,51 @@ class Worker:
             # A successful return completes the message even when the
             # deadline elapsed mid-flight (recorded in stats.timeouts
             # above): the work — side effects, generated response — is
-            # done, and retrying would discard and re-execute it.
+            # done, and retrying would discard and re-execute it. (A
+            # WATCHDOG-abandoned call never reaches here — it lost the
+            # claim above.)
             self.manager.complete_message(msg, elapsed)
             with self.stats._mu:
                 self.stats.succeeded += 1
-            return
+            return True
         reason = (f"timeout after {elapsed:.3f}s ({err!r})" if timed_out
                   else repr(err))
         self._handle_failure(msg, reason, elapsed, timed_out)
+        return True
+
+    # -- watchdog (reference worker.go:166 context.WithTimeout, made hard) ----
+
+    def _watchdog_loop(self) -> None:
+        """Abandon calls that run past their hard deadline: free the
+        concurrency slot and push the message through the timeout/retry
+        path. The wedged call itself cannot be killed (Python threads);
+        it is disowned — its eventual return is dropped by the claim
+        arbitration in _process_message."""
+        while not self._stop.wait(0.05):
+            now = self._clock.now()
+            expired = []
+            with self._inflight_mu:
+                for token, rec in list(self._inflight.items()):
+                    if now >= rec.deadline:
+                        expired.append((token, rec))
+            for token, rec in expired:
+                if not rec.claim():
+                    continue  # finished in the window; thread handles it
+                with self._inflight_mu:
+                    self._inflight.pop(token, None)
+                rec.ctx.cancel()
+                self._sem.release()          # free the wedged slot
+                elapsed = now - rec.start
+                with self.stats._mu:
+                    self.stats.processed += 1
+                    self.stats.total_process_time += elapsed
+                    self.stats.timeouts += 1
+                log.warning("message %s watchdog-abandoned after %.3fs "
+                            "(hard deadline)", rec.msg.id, elapsed)
+                self._handle_failure(
+                    rec.msg,
+                    f"watchdog: hard deadline exceeded after {elapsed:.3f}s",
+                    elapsed, True)
 
     # -- failure path (worker.go:202-239, properly wired) --------------------
 
