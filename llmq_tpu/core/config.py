@@ -78,10 +78,24 @@ class WorkerConfig:
     max_concurrent: int = 50
     # Hard per-message deadline enforcement (reference worker.go:166
     # context.WithTimeout semantics): a process function that wedges past
-    # message.timeout is abandoned by the watchdog — its slot is freed
-    # and the message takes the timeout/retry path. The wedged call keeps
-    # running on its (daemon) thread; Python cannot kill it.
+    # ``message.timeout * hard_deadline_grace`` is abandoned by the
+    # watchdog — its slot is freed and the message takes the
+    # timeout/retry path. The wedged call keeps running on its (daemon)
+    # thread; Python cannot kill it.
+    #
+    # At-least-once implication: abandonment means the original call may
+    # STILL complete its side effects after the retry re-executes them —
+    # duplicate execution. The grace multiple exists to keep that risk
+    # confined to genuinely wedged calls: the cooperative deadline (what
+    # ``ctx.expired()`` reports, and what counts as a timeout) stays at
+    # 1× ``message.timeout``; a merely-slow handler that returns between
+    # 1× and ``grace``× completes normally (work is never discarded and
+    # re-executed — the module invariant). Only calls still running at
+    # grace× are declared wedged. Set grace to 1.0 for strict reference
+    # context.WithTimeout semantics (and accept duplicates for any slow
+    # handler), or hard_deadline=False for purely cooperative deadlines.
     hard_deadline: bool = True
+    hard_deadline_grace: float = 2.0
 
 
 @dataclass

@@ -30,8 +30,8 @@ Fixes over the reference (SURVEY.md #5-#7):
 from __future__ import annotations
 
 import itertools
+import queue as _queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
@@ -81,14 +81,20 @@ class _Inflight:
     deadline — exactly one side wins and handles completion/failure and
     the semaphore slot."""
 
-    __slots__ = ("msg", "ctx", "start", "deadline", "_claimed", "_mu")
+    __slots__ = ("msg", "ctx", "start", "deadline", "pool", "_claimed",
+                 "_mu")
 
     def __init__(self, msg: Message, ctx: ProcessContext, start: float,
-                 deadline: float) -> None:
+                 deadline: float, pool=None) -> None:
         self.msg = msg
         self.ctx = ctx
         self.start = start
         self.deadline = deadline
+        #: The pool that dispatched this call — grow/shrink must target
+        #: IT, not whatever pool the worker holds later (a stop()/start()
+        #: cycle swaps pools; shrinking the fresh one would leave it a
+        #: thread short of the semaphore forever).
+        self.pool = pool
         self._claimed = False
         self._mu = threading.Lock()
 
@@ -98,6 +104,103 @@ class _Inflight:
                 return False
             self._claimed = True
             return True
+
+
+class _DispatchPool:
+    """Daemon-thread pool whose REAL capacity tracks the concurrency
+    semaphore. A watchdog abandonment frees a semaphore slot but the
+    wedged call still occupies its pool thread; without compensation the
+    dispatch loop would keep pulling messages that just queue inside the
+    pool — drained from the shared queue, trapped locally with no
+    deadline (their clock only starts when the thread picks them up),
+    invisible to the retry machinery. ``grow()`` spawns a replacement
+    thread per abandonment; ``shrink()`` retires one thread when the
+    wedged call finally returns, so capacity converges back."""
+
+    def __init__(self, capacity: int, name: str) -> None:
+        self._q: _queue.SimpleQueue = _queue.SimpleQueue()
+        self._mu = threading.Lock()
+        self._name = name
+        self._cap = capacity          # target live-thread count
+        self._seq = 0
+        self._shrink = 0
+        self._live: set = set()       # threads not yet exited
+        self._shut = False
+
+    def _spawn_locked(self) -> None:
+        self._seq += 1
+        t = threading.Thread(target=self._run,
+                             name=f"{self._name}-{self._seq}",
+                             daemon=True)
+        self._live.add(t)
+        t.start()
+
+    def _run(self) -> None:
+        me = threading.current_thread()
+        try:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                fn, args = item
+                try:
+                    fn(*args)
+                except Exception:  # noqa: BLE001 — a task failure must
+                    # never kill the pool thread (completion plumbing
+                    # bugs would otherwise silently strand messages).
+                    log.exception("dispatch task failed in pool %s",
+                                  self._name)
+                with self._mu:
+                    if self._shrink > 0:
+                        # A replacement was spawned for an abandonment
+                        # that has since returned: retire one thread
+                        # (any thread — capacity is what matters).
+                        self._shrink -= 1
+                        return
+        finally:
+            with self._mu:
+                self._live.discard(me)
+
+    def submit(self, fn, *args) -> None:
+        with self._mu:
+            if self._shut:
+                raise RuntimeError("dispatch pool is shut down")
+            # Enqueue under the lock: shutdown() also enqueues its exit
+            # sentinels under it, so an item can never land BEHIND the
+            # sentinels and be silently dropped.
+            self._q.put((fn, args))
+            if len(self._live) < self._cap:
+                self._spawn_locked()   # lazy spawn, up to capacity
+
+    def grow(self) -> None:
+        """One thread is wedged on an abandoned call: add a replacement
+        so live capacity stays at the semaphore's count."""
+        with self._mu:
+            if not self._shut:
+                self._cap += 1
+                self._spawn_locked()
+
+    def shrink(self) -> None:
+        """An abandoned call returned — its thread is usable again;
+        retire one thread to undo the matching ``grow()``."""
+        with self._mu:
+            self._cap = max(1, self._cap - 1)
+            self._shrink += 1
+
+    def shutdown(self, wait: bool = True) -> None:
+        import time as _time
+        with self._mu:
+            self._shut = True
+            live = list(self._live)
+            for _ in live:
+                self._q.put(None)
+        if wait:
+            # One overall deadline — wedged threads never consume their
+            # sentinel, and stop() must be bounded regardless of how
+            # many are stuck.
+            deadline = _time.monotonic() + 5.0
+            for t in live:
+                t.join(timeout=max(0.0, deadline - _time.monotonic()))
 
 
 class BackoffStrategy:
@@ -196,7 +299,7 @@ class Worker:
         self._sem = threading.Semaphore(self.wconfig.max_concurrent)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool: Optional[_DispatchPool] = None
         self._watchdog: Optional[threading.Thread] = None
         self._inflight: Dict[int, _Inflight] = {}
         self._inflight_mu = threading.Lock()
@@ -216,9 +319,8 @@ class Worker:
         self._stop.clear()
         if self._owned_delayed:
             self.delayed_queue.start()
-        self._pool = ThreadPoolExecutor(
-            max_workers=self.wconfig.max_concurrent,
-            thread_name_prefix=f"worker-{self.name}")
+        self._pool = _DispatchPool(self.wconfig.max_concurrent,
+                                   f"worker-{self.name}")
         self._thread = threading.Thread(
             target=self._process_loop, name=f"worker-loop-{self.name}", daemon=True)
         self._thread.start()
@@ -302,7 +404,14 @@ class Worker:
         rec: Optional[_Inflight] = None
         token = -1
         if deadline is not None and self._watchdog is not None:
-            rec = _Inflight(msg, ctx, start, deadline)
+            # The watchdog fires at a GRACE multiple of the cooperative
+            # deadline: a slow-but-finishing handler between 1× and
+            # grace× completes normally (counted in stats.timeouts, work
+            # kept); only calls still running at grace× are abandoned —
+            # which risks duplicate side effects (see WorkerConfig).
+            grace = max(1.0, self.wconfig.hard_deadline_grace)
+            rec = _Inflight(msg, ctx, start, start + msg.timeout * grace,
+                            pool=self._pool)
             token = next(self._inflight_seq)
             with self._inflight_mu:
                 self._inflight[token] = rec
@@ -326,6 +435,11 @@ class Worker:
                     "message %s returned %.3fs after its watchdog "
                     "abandonment; result dropped",
                     msg.id, self._clock.now() - rec.deadline)
+                if rec.pool is not None:
+                    # This thread was written off when the call was
+                    # abandoned (a replacement was spawned); retire one
+                    # thread so pool capacity matches the semaphore again.
+                    rec.pool.shrink()
                 return False
         elapsed = self._clock.now() - start
         timed_out = ctx.expired()
@@ -372,6 +486,11 @@ class Worker:
                     self._inflight.pop(token, None)
                 rec.ctx.cancel()
                 self._sem.release()          # free the wedged slot
+                if rec.pool is not None:
+                    # The freed semaphore slot is only real capacity if
+                    # a thread exists to serve it — the wedged call
+                    # still occupies one; spawn a replacement.
+                    rec.pool.grow()
                 elapsed = now - rec.start
                 with self.stats._mu:
                     self.stats.processed += 1

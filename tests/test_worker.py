@@ -185,6 +185,84 @@ class TestBackoff:
         assert b.next_backoff(99) == 2.5
 
 
+class TestWatchdog:
+    """Hard-deadline watchdog: wedged calls are abandoned at the grace
+    multiple of the timeout, the freed semaphore slot is backed by a
+    REPLACEMENT pool thread (capacity stays real), and slow-but-finishing
+    calls inside the grace window keep their work."""
+
+    def _worker(self, backend, handler, *, max_concurrent=1, grace=1.0):
+        import time as _t
+        qm = QueueManager("wd", backend=backend, enable_metrics=False)
+        qm.config.queue.retry.max_retries = 0
+        qm.config.queue.worker.max_concurrent = max_concurrent
+        qm.config.queue.worker.process_interval = 0.01
+        qm.config.queue.worker.hard_deadline = True
+        qm.config.queue.worker.hard_deadline_grace = grace
+        dlq = DeadLetterQueue()
+        w = Worker("wd0", qm, handler, dead_letter_queue=dlq)
+        return qm, dlq, w, _t
+
+    def test_wedged_call_abandoned_and_capacity_restored(self, queue_backend):
+        release = threading.Event()
+        done_ok = threading.Event()
+
+        def handler(ctx, m):
+            if m.metadata.get("wedge"):
+                release.wait(10.0)
+            else:
+                done_ok.set()
+
+        qm, dlq, w, t = self._worker(queue_backend, handler)
+        wedged = Message(id="wedged", timeout=0.1, max_retries=0,
+                         metadata={"wedge": True})
+        qm.push_message(wedged)
+        w.start()
+        try:
+            deadline = t.time() + 5.0
+            # Poll on the DLQ (the LAST observable effect of the failure
+            # path) — status flips to TIMEOUT before the DLQ push lands.
+            while dlq.size() == 0 and t.time() < deadline:
+                t.sleep(0.02)
+            assert wedged.status == MessageStatus.TIMEOUT
+            assert dlq.size() == 1
+            # max_concurrent=1 and the wedged call still occupies its
+            # original thread: the next message must run on the
+            # watchdog's replacement thread.
+            qm.push_message(Message(id="after", timeout=5.0, max_retries=0))
+            assert done_ok.wait(5.0), (
+                "message dispatched after an abandonment never ran — "
+                "pool capacity was not restored")
+        finally:
+            release.set()     # un-wedge; late return must be dropped
+            t.sleep(0.1)
+            w.stop()
+        assert wedged.status == MessageStatus.TIMEOUT  # result stayed dropped
+
+    def test_slow_call_inside_grace_window_completes(self, queue_backend):
+        def slow(ctx, m):
+            import time
+            time.sleep(0.25)   # past 1× timeout, well inside 20× grace
+            m.response = "done"
+
+        qm, dlq, w, t = self._worker(queue_backend, slow, grace=20.0)
+        m = Message(timeout=0.1, max_retries=0)
+        qm.push_message(m)
+        w.start()
+        try:
+            deadline = t.time() + 5.0
+            while not m.status == MessageStatus.COMPLETED and t.time() < deadline:
+                t.sleep(0.02)
+        finally:
+            w.stop()
+        # Slow ≠ wedged: the work finished and must be kept (the module
+        # invariant), recorded as a timeout overrun, never re-executed.
+        assert m.status == MessageStatus.COMPLETED
+        assert m.response == "done"
+        assert w.stats.to_dict()["timeouts"] == 1
+        assert dlq.size() == 0
+
+
 class TestThreadedLoop:
     def test_real_loop_processes(self, queue_backend):
         # One real-time smoke test of the background loop (everything else
