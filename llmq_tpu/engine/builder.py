@@ -89,6 +89,13 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             # that requires the checkpoint itself to be loaded shard-wise
             # on a host with enough RAM (checkpoint.py loads to host).
             params = quantize_params(params)
+        mesh = None
+        if cfg.tpu.mesh_shape:
+            # Sharded serving (BASELINE config #5): the engine runs the
+            # model TP over the declared mesh; the quantization flag
+            # flows into param_shardings inside the executor.
+            from llmq_tpu.parallel import make_mesh
+            mesh = make_mesh(dict(cfg.tpu.mesh_shape))
         executor = JaxExecutor(
             mcfg, params,
             batch_size=ex.max_batch_size,
@@ -96,7 +103,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             num_pages=ex.kv_pages,
             prefill_buckets=list(ex.prefill_buckets),
             eos_id=tokenizer.eos_id,
-            chunk_size=ex.decode_chunk)
+            chunk_size=ex.decode_chunk,
+            mesh=mesh)
         if warmup:
             executor.warmup()
     else:

@@ -179,6 +179,21 @@ class JaxExecutor:
     stream through it in chunks (continuation prefill over the same block
     table). The KV pool is donated through every call, so the working set
     stays at one pool (plus transient activations) in HBM.
+
+    **Sharded serving** (``mesh=``): pass a ``jax.sharding.Mesh`` with a
+    ``tp`` axis and the executor serves the model tensor-parallel —
+    params sharded per ``parallel/sharding.param_shardings`` (quantized
+    trees included), the KV pool sharded on the KV-head axis (each chip
+    holds only its heads' cache — how a 70B cache fits a v5e-16,
+    BASELINE config #5), and every prefill/decode program jitted under
+    GSPMD, which inserts the ICI collectives (one all-reduce after wo /
+    w_down, logits all-gather at the head). This is the serving seam the
+    reference stubs with fabricated worker URLs
+    (/root/reference/internal/scheduler/scheduler.go:299-301). Batch-dim
+    arrays stay replicated: data parallelism across requests is engine
+    replication (LoadBalancer over engines), not intra-engine sharding.
+    The Pallas kernels are single-chip programs, so sharded tracing uses
+    the pure-JAX paths GSPMD can partition (cfg.pallas=False).
     """
 
     def __init__(self, model_cfg, params, *, batch_size: int = 8,
@@ -186,7 +201,7 @@ class JaxExecutor:
                  prefill_buckets: Optional[List[int]] = None,
                  top_k: int = 0, top_p: float = 1.0, eos_id: int = 2,
                  cache_dtype=None, seed: int = 0,
-                 chunk_size: int = 16) -> None:
+                 chunk_size: int = 16, mesh=None) -> None:
         import jax
         import jax.numpy as jnp
         from functools import partial
@@ -197,6 +212,22 @@ class JaxExecutor:
 
         self._jax = jax
         self._jnp = jnp
+        self.mesh = mesh
+        if mesh is not None and mesh.size > 1:
+            import dataclasses
+
+            from llmq_tpu.ops.quant import is_quantized
+            from llmq_tpu.parallel.sharding import (
+                kv_cache_shardings, param_shardings, shard_params)
+
+            model_cfg = dataclasses.replace(model_cfg, pallas=False)
+            quantized = is_quantized(params["layers"]["wq"])
+            params = shard_params(
+                params, param_shardings(model_cfg, mesh,
+                                        quantized=quantized))
+            self._kv_shardings = kv_cache_shardings(model_cfg, mesh)
+        else:
+            self._kv_shardings = None
         self.model_cfg = model_cfg
         self.params = params
         max_pages_per_seq = max(
@@ -205,8 +236,17 @@ class JaxExecutor:
                                  max_pages_per_seq, eos_id)
         self.chunk_size = max(1, chunk_size)
         self.prefill_buckets = sorted(prefill_buckets or [32, 128, 512])
-        self.cache = init_kv_pages(model_cfg, num_pages, page_size,
-                                   dtype=cache_dtype)
+        if self._kv_shardings is not None:
+            # Create the pool ALREADY sharded (out_shardings) — a 70B
+            # pool materialized on one chip before resharding would OOM
+            # the chip sharding exists to relieve.
+            self.cache = jax.jit(
+                lambda: init_kv_pages(model_cfg, num_pages, page_size,
+                                      dtype=cache_dtype),
+                out_shardings=self._kv_shardings)()
+        else:
+            self.cache = init_kv_pages(model_cfg, num_pages, page_size,
+                                       dtype=cache_dtype)
         self._key = jax.random.PRNGKey(seed)
 
         cfg = model_cfg

@@ -56,6 +56,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    #: Allow the single-chip Pallas kernels (env LLMQ_PALLAS still
+    #: applies). Mesh-sharded executors set False: GSPMD cannot
+    #: partition a Pallas call, so sharded programs must trace the
+    #: pure-JAX paths it CAN partition (static — part of the jit key).
+    pallas: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -320,11 +325,12 @@ def forward_prefill(
         # Write this layer's KV into its slice of the pool.
         k_pool, v_pool = paged_kv_write_prefill(
             k_pool, v_pool, k, v, block_tables, positions, lengths,
-            jnp.int32(l))
+            jnp.int32(l), enabled=cfg.pallas)
         # Attend over the full paged history (covers continuation turns);
         # causality enforced via absolute positions.
         attn = dispatch_prefill_attention(q, k_pool, v_pool, block_tables,
-                                          positions, seq_lens, l)
+                                          positions, seq_lens, l,
+                                          enabled=cfg.pallas)
         h = h + linear(attn.reshape(B, T, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
@@ -388,7 +394,8 @@ def forward_decode(
         # this step; inactive rows redirect to reserved page 0).
         attn, k_pool, v_pool = paged_decode_step(
             q, k, v, k_pool, v_pool, block_tables, seq_lens,
-            page_of, slot_of, jnp.int32(l))                # (B, H, D)
+            page_of, slot_of, jnp.int32(l),
+            enabled=cfg.pallas)                            # (B, H, D)
         h = h + linear(attn.reshape(B, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),

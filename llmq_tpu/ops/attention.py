@@ -138,16 +138,18 @@ def paged_decode_attention_pooled(
     return _gqa_attend(q, k, v, seq_lens)
 
 
-def _kernel_route(k_pool, *, extra_ok: bool = True):
+def _kernel_route(k_pool, *, extra_ok: bool = True, enabled: bool = True):
     """Shared LLMQ_PALLAS routing policy for the paged-KV kernels.
 
     Returns (use_kernel, interpret). Kernel eligibility: not disabled
-    (``LLMQ_PALLAS=0``), ``extra_ok``, H_kv·D lane-aligned, and either a
-    TPU backend or ``LLMQ_PALLAS=interpret`` (CI coverage of kernel
-    bodies without a TPU)."""
+    (``LLMQ_PALLAS=0`` or ``enabled=False`` — the caller's static
+    opt-out, e.g. mesh-sharded programs where GSPMD cannot partition a
+    single-chip Pallas call), ``extra_ok``, H_kv·D lane-aligned, and
+    either a TPU backend or ``LLMQ_PALLAS=interpret`` (CI coverage of
+    kernel bodies without a TPU)."""
     mode = os.environ.get("LLMQ_PALLAS", "auto")
     aligned = k_pool.shape[3] % 128 == 0
-    if mode == "0" or not extra_ok or not aligned:
+    if mode == "0" or not enabled or not extra_ok or not aligned:
         return False, False
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -158,7 +160,7 @@ def _kernel_route(k_pool, *, extra_ok: bool = True):
 
 
 def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
-                   *, distinct_pages: bool = False):
+                   *, distinct_pages: bool = False, enabled: bool = True):
     """Write N token rows into layer ``layer`` of the stacked pool.
 
     TPU + ``distinct_pages=True`` (decode: every live row targets its
@@ -171,7 +173,8 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
     N = k_new.shape[0]
     kn = k_new.reshape(N, -1)
     vn = v_new.reshape(N, -1)
-    use_kernel, interpret = _kernel_route(k_pool, extra_ok=distinct_pages)
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=distinct_pages,
+                                          enabled=enabled)
     if use_kernel:
         from llmq_tpu.ops.pallas.kv_write import kv_cache_write_pallas
         return kv_cache_write_pallas(k_pool, v_pool, kn, vn,
@@ -183,7 +186,7 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
 
 
 def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
-                           lengths, layer):
+                           lengths, layer, *, enabled: bool = True):
     """Write a prefill chunk's KV (k/v: (B, T, H_kv, D)) into layer
     ``layer`` of the stacked pool.
 
@@ -199,7 +202,8 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
     B, T = k.shape[0], k.shape[1]
     page_size = k_pool.shape[2]
     GD = k_pool.shape[3]
-    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1))
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1),
+                                          enabled=enabled)
     if use_kernel:
         from llmq_tpu.ops.pallas.kv_write import kv_prefill_write_pallas
         start = positions[0, 0]
@@ -233,7 +237,8 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
 
 
 def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
-                               seq_lens, layer) -> jnp.ndarray:
+                               seq_lens, layer, *,
+                               enabled: bool = True) -> jnp.ndarray:
     """Prefill-chunk attention over the paged pool; q (B, T, H, D).
 
     B == 1 on TPU: Pallas paged prefill kernel reading the pool
@@ -252,7 +257,8 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
     """
     B, T = q.shape[0], q.shape[1]
     page_size = k_pool.shape[2]
-    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1))
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1),
+                                          enabled=enabled)
     if use_kernel:
         from llmq_tpu.ops.pallas.prefill_attention import (
             paged_prefill_attention_pallas)
@@ -270,7 +276,8 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
 
 
 def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
-                      seq_lens, page_of, slot_of, layer):
+                      seq_lens, page_of, slot_of, layer, *,
+                      enabled: bool = True):
     """One decode layer's KV write + attention, fused where possible.
 
     TPU: ONE Pallas kernel does both — the current token's K/V is
@@ -284,7 +291,7 @@ def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
     # page_size % 8: the fused kernel writes back the 8-sublane tile
     # holding the new row (fused_decode.py) — sub-8 pages can't.
     use_kernel, interpret = _kernel_route(
-        k_pool, extra_ok=k_pool.shape[2] % 8 == 0)
+        k_pool, extra_ok=k_pool.shape[2] % 8 == 0, enabled=enabled)
     if use_kernel:
         from llmq_tpu.ops.pallas.fused_decode import (
             fused_decode_attention_pallas)
@@ -294,7 +301,7 @@ def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
         return attn, k_pool, v_pool
     k_pool, v_pool = paged_kv_write(k_pool, v_pool, k_new, v_new,
                                     page_of, slot_of, layer,
-                                    distinct_pages=True)
+                                    distinct_pages=True, enabled=enabled)
     attn = paged_decode_attention_pooled(q, k_pool, v_pool, block_tables,
                                          seq_lens, layer)
     return attn, k_pool, v_pool
