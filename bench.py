@@ -245,10 +245,23 @@ def _peak_flops(kind: str) -> float:
     return 197e12
 
 
+def _enable_bench_cache() -> None:
+    """Persistent XLA compilation cache for all TPU bench sections: a
+    re-run of the bench (or any serving process with the same geometry)
+    deserializes the compiled programs instead of paying the multi-minute
+    warmup again. LLMQ_BENCH_CACHE_DIR overrides; empty disables."""
+    from llmq_tpu.parallel import enable_compilation_cache
+
+    cache = os.environ.get("LLMQ_BENCH_CACHE_DIR",
+                           os.path.join(REPO, ".jax_cache"))
+    enable_compilation_cache(cache)
+
+
 def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     import jax
     import numpy as np
 
+    _enable_bench_cache()
     backend = jax.default_backend()
     dev = jax.devices()[0]
     log(f"[tpu] backend={backend} device={dev.device_kind}")
@@ -374,6 +387,7 @@ def bench_poisson_tpu(model_name: str, rate_per_s: float,
             "LLMQ_BENCH_FORCE_CPU"):
         log("[poisson-tpu] no accelerator; skipping")
         return None
+    _enable_bench_cache()
 
     from llmq_tpu.engine.engine import GenRequest, InferenceEngine
     from llmq_tpu.engine.executor import JaxExecutor

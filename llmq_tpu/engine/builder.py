@@ -46,6 +46,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         from llmq_tpu.models.llama import get_config, init_params
         from llmq_tpu.models.checkpoint import import_hf_llama, load_checkpoint
 
+        if cfg.tpu.compilation_cache_dir:
+            from llmq_tpu.parallel import enable_compilation_cache
+            enable_compilation_cache(cfg.tpu.compilation_cache_dir)
+
         mcfg = get_config(cfg.model.name, max_seq_len=cfg.model.max_seq_len)
         if cfg.model.vocab_size:
             mcfg = get_config(cfg.model.name,

@@ -252,7 +252,21 @@ class JaxExecutor:
         cfg = model_cfg
         eos = eos_id
 
-        @partial(jax.jit, donate_argnums=(1,))
+        # Pin the cache's OUTPUT sharding on the mesh path: donated
+        # buffers leave the program with whatever sharding GSPMD found
+        # profitable (it happily splits the flat H_kv·D axis even when
+        # the head count doesn't divide), and the next program's
+        # AOT-compiled signature would then reject the resharded pool.
+        if self._kv_shardings is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            _repl = NamedSharding(mesh, PartitionSpec())
+            jit_step = partial(jax.jit, donate_argnums=(1,),
+                               out_shardings=(_repl,
+                                              dict(self._kv_shardings)))
+        else:
+            jit_step = partial(jax.jit, donate_argnums=(1,))
+
+        @jit_step
         def _prefill_step(params, cache, tokens, positions, lengths,
                           block_tables, temperature, key):
             logits, cache = forward_prefill(
@@ -262,7 +276,7 @@ class JaxExecutor:
                                top_k=top_k, top_p=top_p)
             return tok[0], cache
 
-        @partial(jax.jit, donate_argnums=(1,))
+        @jit_step
         def _decode_step(params, cache, tokens, positions, block_tables,
                          temperatures, key):
             logits, cache = forward_decode(
@@ -273,7 +287,7 @@ class JaxExecutor:
 
         K = self.chunk_size
 
-        @partial(jax.jit, donate_argnums=(1,))
+        @jit_step
         def _decode_chunk(params, cache, tokens, positions, block_tables,
                           temperatures, budgets, key):
             """K decode steps on device: sampling, EOS latching and
@@ -303,6 +317,10 @@ class JaxExecutor:
         self._prefill_step = _prefill_step
         self._decode_step = _decode_step
         self._decode_chunk = _decode_chunk
+        #: AOT-compiled executables by program name (filled by warmup;
+        #: call sites prefer these — the jit wrappers re-trace on first
+        #: call, the executables don't).
+        self._aot: Dict[str, object] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -316,9 +334,78 @@ class JaxExecutor:
         self._key, sub = self._jax.random.split(self._key)
         return sub
 
+    def _warmup_parallel(self) -> None:
+        """AOT-compile every program CONCURRENTLY from abstract shapes
+        and keep the executables.
+
+        ``jit.lower(...).compile()`` needs no real buffers (the donated
+        multi-GB KV pool is passed as a ShapeDtypeStruct, so no second
+        pool is ever allocated) and XLA compilation releases the GIL, so
+        the decode-chunk giant and all prefill buckets compile in
+        parallel — first-start warmup costs max(program) instead of
+        sum(programs). The compiled executables are stored in
+        ``self._aot`` and CALLED directly at runtime (the call sites
+        prefer them over the jit wrappers), so each program is traced
+        exactly once; with the persistent compilation cache
+        (parallel/mesh.enable_compilation_cache) a restart pays only
+        tracing + cache deserialization — seconds, not minutes.
+        """
+        import jax
+        from concurrent.futures import ThreadPoolExecutor
+
+        jnp = self._jnp
+        spec = self.spec
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        # Params/cache keep their shardings (mesh path: the AOT program
+        # must be partitioned exactly like the runtime arrays).
+        abstract = lambda tree: jax.tree.map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            tree)
+        p = abstract(self.params)
+        c = abstract(self.cache)
+        key = sds((2,), jnp.uint32)
+        B, MP = spec.batch_size, spec.max_pages_per_seq
+        i32, f32 = jnp.int32, jnp.float32
+
+        jobs = []
+        for T in self.prefill_buckets:
+            jobs.append((f"prefill_b{T}", self._prefill_step,
+                         (p, c, sds((1, T), i32), sds((1, T), i32),
+                          sds((1,), i32), sds((1, MP), i32),
+                          sds((1,), f32), key)))
+        jobs.append(("decode", self._decode_step,
+                     (p, c, sds((B,), i32), sds((B,), i32),
+                      sds((B, MP), i32), sds((B,), f32), key)))
+        if self.chunk_size > 1:
+            jobs.append(("decode_chunk", self._decode_chunk,
+                         (p, c, sds((B,), i32), sds((B,), i32),
+                          sds((B, MP), i32), sds((B,), f32),
+                          sds((B,), i32), key)))
+
+        def compile_one(job):
+            name, fn, args = job
+            self._aot[name] = fn.lower(*args).compile()
+            return name
+
+        with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+            for name in pool.map(compile_one, jobs):
+                log.info("warmup compiled %s", name)
+
     def warmup(self) -> None:
         """Compile the decode step and every prefill bucket up front
-        (the reference has no analogue; SURVEY §7 'warmup at startup')."""
+        (the reference has no analogue; SURVEY §7 'warmup at startup'):
+        parallel AOT compile, then one tiny execution per program as a
+        smoke pass (near-free — the executables already exist)."""
+        try:
+            self._warmup_parallel()
+        except Exception:  # noqa: BLE001 — AOT is an optimization; the
+            # execution pass below compiles everything anyway.
+            log.exception("parallel AOT warmup failed; falling back")
+            self._aot.clear()
         spec = self.spec
         bt = np.zeros((1, spec.max_pages_per_seq), np.int32)
         prev = 0
@@ -350,8 +437,9 @@ class JaxExecutor:
         padded[: len(chunk)] = chunk
         positions = np.minimum(start_pos + np.arange(T),
                                start_pos + len(chunk) - 1)
+        fn = self._aot.get(f"prefill_b{T}", self._prefill_step)
         with annotate(f"prefill_b{T}"):  # named region in xprof traces
-            tok, self.cache = self._prefill_step(
+            tok, self.cache = fn(
                 self.params, self.cache,
                 jnp.asarray(padded)[None, :],
                 jnp.asarray(positions, jnp.int32)[None, :],
@@ -394,7 +482,8 @@ class JaxExecutor:
                block_tables: np.ndarray,
                temperatures: np.ndarray) -> np.ndarray:
         jnp = self._jnp
-        toks, self.cache = self._decode_step(
+        fn = self._aot.get("decode", self._decode_step)
+        toks, self.cache = fn(
             self.params, self.cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
@@ -407,8 +496,9 @@ class JaxExecutor:
                      block_tables: np.ndarray, temperatures: np.ndarray,
                      budgets: np.ndarray) -> np.ndarray:
         jnp = self._jnp
+        fn = self._aot.get("decode_chunk", self._decode_chunk)
         with annotate("decode_chunk"):
-            toks, self.cache = self._decode_chunk(
+            toks, self.cache = fn(
                 self.params, self.cache,
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(positions, jnp.int32),

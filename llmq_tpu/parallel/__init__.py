@@ -16,6 +16,7 @@ backend (SURVEY.md §2: "no DP/TP/PP/SP/EP... no NCCL/MPI"); its
 """
 
 from llmq_tpu.parallel.mesh import (  # noqa: F401
+    enable_compilation_cache,
     make_mesh,
     single_device_mesh,
     distributed_init,
