@@ -170,6 +170,24 @@ class EchoExecutor:
 # -- JAX ----------------------------------------------------------------------
 
 
+class ChunkHandle:
+    """In-flight decode chunk: ``out`` is the (B, K) token matrix to
+    fetch; ``tok``/``pos``/``done`` are the device-resident end state a
+    speculative next chunk consumes directly (no host round-trip)."""
+
+    __slots__ = ("out", "tok", "pos", "done")
+
+    def __init__(self, out, tok, pos, done) -> None:
+        self.out = out
+        self.tok = tok
+        self.pos = pos
+        self.done = done
+
+    def fetch(self) -> np.ndarray:
+        """Blocking host transfer of the chunk's sampled tokens."""
+        return np.asarray(self.out)
+
+
 class JaxExecutor:
     """Paged continuous-batching executor over models/llama.py.
 
@@ -260,11 +278,16 @@ class JaxExecutor:
         if self._kv_shardings is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             _repl = NamedSharding(mesh, PartitionSpec())
+            kvs = dict(self._kv_shardings)
             jit_step = partial(jax.jit, donate_argnums=(1,),
-                               out_shardings=(_repl,
-                                              dict(self._kv_shardings)))
+                               out_shardings=(_repl, kvs))
+            # decode_chunk returns (out, tok, pos, done, cache).
+            jit_chunk = partial(jax.jit, donate_argnums=(1,),
+                                out_shardings=(_repl, _repl, _repl,
+                                               _repl, kvs))
         else:
             jit_step = partial(jax.jit, donate_argnums=(1,))
+            jit_chunk = jit_step
 
         @jit_step
         def _prefill_step(params, cache, tokens, positions, lengths,
@@ -287,32 +310,64 @@ class JaxExecutor:
 
         K = self.chunk_size
 
-        @jit_step
+        @jit_chunk
         def _decode_chunk(params, cache, tokens, positions, block_tables,
-                          temperatures, budgets, key):
-            """K decode steps on device: sampling, EOS latching and
+                          temperatures, budgets, done_in, key):
+            """Up to K decode steps on device: sampling, EOS latching and
             per-row budgets stay in the program; one host transfer of
-            (B, K) token ids per call."""
-            def body(carry, step):
-                cache, tok, pos, done = carry
-                j, key_j = step
-                active = ~done
+            (B, K) token ids per call — or NONE, when the next call
+            consumes the returned carry directly (pipelined decode).
+
+            ``lax.while_loop`` instead of a scan: the program EXITS as
+            soon as every row is done (EOS-latched, budget-exhausted, or
+            latched on ENTRY via ``done_in`` — how a speculative next
+            chunk keeps rows the host has since finished frozen on
+            reserved page 0), so small budgets cost exactly the steps
+            run — one compiled program serves every granularity from 1
+            to K (adaptive admission latency, VERDICT r3 #3).
+
+            Returns ``(out (B, K), tok (B,), pos (B,), done (B,),
+            cache)`` — the tail three are the device-resident carry the
+            next call can take WITHOUT a host round-trip.
+            """
+            B = tokens.shape[0]
+            keys = jax.random.split(key, K)
+            out0 = jnp.full((B, K), eos, jnp.int32)
+            # Two distinct latches — conflating them truncates every
+            # multi-chunk generation: ``done_in``/EOS are PERSISTENT
+            # (carried out: the row is finished for good), while budget
+            # exhaustion is THIS-CHUNK-ONLY (the row merely pauses; the
+            # speculative next chunk resumes it from the carried
+            # tok/pos with a fresh budget).
+            frozen0 = done_in
+
+            def cond(st):
+                j, _, _, _, frozen, _ = st
+                return (j < K) & jnp.any(~frozen & (j < budgets))
+
+            def body(st):
+                j, cache, tok, pos, frozen, out = st
+                active = (~frozen) & (j < budgets)
                 logits, cache = forward_decode(
                     params, cfg, tok, pos, cache, block_tables,
                     active=active)
-                nxt = sample_token(logits, key_j, temperature=temperatures,
+                nxt = sample_token(logits, keys[j],
+                                   temperature=temperatures,
                                    top_k=top_k, top_p=top_p)
-                nxt = jnp.where(active, nxt, eos).astype(jnp.int32)
+                emit = jnp.where(active, nxt, eos).astype(jnp.int32)
+                out = jax.lax.dynamic_update_slice(
+                    out, emit[:, None], (0, j))
+                # Budget-paused rows keep their last REAL token — it is
+                # the next chunk's input; only active rows advance.
+                tok = jnp.where(active, nxt.astype(jnp.int32), tok)
                 pos = pos + active.astype(jnp.int32)
-                done = done | (nxt == eos) | (j + 1 >= budgets)
-                return (cache, nxt, pos, done), nxt
+                frozen = frozen | (active & (nxt == eos))
+                return (j + 1, cache, tok, pos, frozen, out)
 
-            keys = jax.random.split(key, K)
-            done0 = budgets <= 0
-            (cache, _, _, _), outs = jax.lax.scan(
-                body, (cache, tokens, positions, done0),
-                (jnp.arange(K), keys))
-            return outs.T, cache  # (B, K)
+            _, cache, tok, pos, frozen, out = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cache, tokens, positions, frozen0, out0))
+            return out, tok, pos, frozen, cache
 
         self._prefill_step = _prefill_step
         self._decode_step = _decode_step
@@ -384,7 +439,7 @@ class JaxExecutor:
             jobs.append(("decode_chunk", self._decode_chunk,
                          (p, c, sds((B,), i32), sds((B,), i32),
                           sds((B, MP), i32), sds((B,), f32),
-                          sds((B,), i32), key)))
+                          sds((B,), i32), sds((B,), jnp.bool_), key)))
 
         def compile_one(job):
             name, fn, args = job
@@ -492,21 +547,51 @@ class JaxExecutor:
             self._next_key())
         return np.asarray(toks)
 
-    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
-                     block_tables: np.ndarray, temperatures: np.ndarray,
-                     budgets: np.ndarray) -> np.ndarray:
+    def decode_chunk_start(self, tokens, positions,
+                           block_tables: np.ndarray,
+                           temperatures: np.ndarray,
+                           budgets: np.ndarray,
+                           carry: Optional["ChunkHandle"] = None
+                           ) -> "ChunkHandle":
+        """Dispatch one chunk WITHOUT a host sync.
+
+        With ``carry`` (the previous call's handle), tokens/positions/
+        done stay device-resident — the chunk starts immediately from
+        the prior chunk's end state, no host round-trip on the critical
+        path (pipelined decode: the engine fetches ``carry.out`` while
+        this chunk runs). Without it, inputs come from host arrays and
+        no row starts latched."""
         jnp = self._jnp
         fn = self._aot.get("decode_chunk", self._decode_chunk)
+        if carry is not None:
+            tok_in, pos_in, done_in = carry.tok, carry.pos, carry.done
+        else:
+            tok_in = jnp.asarray(tokens, jnp.int32)
+            pos_in = jnp.asarray(positions, jnp.int32)
+            done_in = jnp.zeros(self.spec.batch_size, bool)
         with annotate("decode_chunk"):
-            toks, self.cache = fn(
+            out, tok, pos, done, self.cache = fn(
                 self.params, self.cache,
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(positions, jnp.int32),
+                tok_in, pos_in,
                 jnp.asarray(block_tables, jnp.int32),
                 jnp.asarray(temperatures, jnp.float32),
                 jnp.asarray(budgets, jnp.int32),
+                done_in,
                 self._next_key())
-        return np.asarray(toks)
+        return ChunkHandle(out, tok, pos, done)
+
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                     block_tables: np.ndarray, temperatures: np.ndarray,
+                     budgets: np.ndarray) -> np.ndarray:
+        h = self.decode_chunk_start(tokens, positions, block_tables,
+                                    temperatures, budgets)
+        return h.fetch()
+
+    def gather_scalars(self, arrs: List) -> np.ndarray:
+        """Stack device scalars and fetch them in ONE transfer (the
+        engine resolves an admission wave's first tokens with a single
+        round-trip)."""
+        return np.asarray(self._jnp.stack(arrs))
 
     def release_slot(self, slot: int) -> None:
         pass  # no per-slot host state

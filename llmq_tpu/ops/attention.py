@@ -24,7 +24,6 @@ Pallas write+attention kernel, else scatter + pure JAX;
 
 from __future__ import annotations
 
-import functools
 import os
 import threading
 

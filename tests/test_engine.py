@@ -484,6 +484,32 @@ def reference_greedy(cfg, params, prompt_ids, n_steps):
 
 
 class TestJaxEngine:
+    def test_multi_chunk_generation_spans_chunks(self, tiny_model):
+        """A generation LONGER than chunk_size must produce identical
+        tokens through the pipelined/speculative path (chunk_size=4) and
+        the single-step path (chunk_size=1) — and run to its full length
+        (r4: a carry bug latched budget-paused rows as done, truncating
+        every multi-chunk generation with a phantom EOS)."""
+        cfg, params = tiny_model
+        tok = ByteTokenizer()
+
+        def run(chunk):
+            ex = JaxExecutor(cfg, params, batch_size=2, page_size=8,
+                             num_pages=64, prefill_buckets=[16, 64],
+                             eos_id=tok.eos_id, chunk_size=chunk)
+            eng = InferenceEngine(ex, tok, enable_metrics=False,
+                                  max_decode_steps=64)
+            h = eng.submit(GenRequest(id="r", prompt="span the chunks",
+                                      max_new_tokens=20))
+            eng.run_until_idle()
+            return h.result
+
+        piped = run(4)      # 20 tokens span 5 chunks
+        single = run(1)
+        assert piped.tokens == single.tokens
+        if piped.finish_reason == "length":
+            assert len(piped.tokens) == 20
+
     def test_greedy_matches_reference(self, tiny_model):
         cfg, params = tiny_model
         eng = make_jax_engine(tiny_model)
