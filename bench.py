@@ -21,10 +21,12 @@ All human-readable progress goes to stderr; stdout carries exactly one
 JSON line.
 
 Env knobs: LLMQ_BENCH_QUEUE_MSGS, LLMQ_BENCH_POISSON_RATE,
-LLMQ_BENCH_POISSON_SECS, LLMQ_BENCH_MODEL, LLMQ_BENCH_BATCH,
-LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SEQ, LLMQ_BENCH_CHUNK,
-LLMQ_BENCH_TPU_POISSON_RATE, LLMQ_BENCH_TPU_POISSON_SECS,
-LLMQ_BENCH_SKIP_TPU.
+LLMQ_BENCH_POISSON_SECS, LLMQ_BENCH_MODEL, LLMQ_BENCH_QUANT,
+LLMQ_BENCH_BATCH, LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SEQ,
+LLMQ_BENCH_CHUNK, LLMQ_BENCH_PAGE, LLMQ_BENCH_SLA_MODEL,
+LLMQ_BENCH_SLA_QUANT, LLMQ_BENCH_TPU_POISSON_RATES,
+LLMQ_BENCH_TPU_POISSON_SECS, LLMQ_BENCH_TPU_SLOTS,
+LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU.
 """
 
 from __future__ import annotations
@@ -257,7 +259,8 @@ def _enable_bench_cache() -> None:
     enable_compilation_cache(cache)
 
 
-def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
+def bench_tpu_decode(model_name: str, batch: int, steps: int,
+                     quant: str = "") -> Optional[Dict]:
     import jax
     import numpy as np
 
@@ -270,17 +273,44 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
         return None
 
     from llmq_tpu.engine.executor import JaxExecutor
-    from llmq_tpu.models.llama import get_config, init_params, param_count
+    from llmq_tpu.models.llama import (get_config, init_params,
+                                       init_params_quantized, param_count)
+
+    # Host<->device round-trip floor: every synchronous fetch pays this
+    # (≈0.1-0.2 ms on a TPU VM; ~70-110 ms through a tunneled dev
+    # runtime). End-to-end latency numbers bottom out at a couple of
+    # RTTs per request — record it so they are interpretable.
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    np.asarray(f(x))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = sorted(rtts)[len(rtts) // 2] * 1e3
+    log(f"[tpu] host<->device RTT ~{rtt_ms:.1f}ms")
 
     max_seq = int(os.environ.get("LLMQ_BENCH_SEQ", "1024"))
     chunk = int(os.environ.get("LLMQ_BENCH_CHUNK", "64"))
+    # 128-token pages: per-DMA cost in the fused kernel is per PAGE, so
+    # serving configs want big pages — and 128 is the largest at which
+    # the fused kernel keeps a LEGAL full-width row tile for GD=1024
+    # models (8B/1B); 256 would force the split write+attention path.
+    page_size = int(os.environ.get("LLMQ_BENCH_PAGE", "128"))
     cfg = get_config(model_name, max_seq_len=max_seq)
-    page_size = 16
     pages_per_seq = max_seq // page_size
     num_pages = batch * pages_per_seq + 1
     log(f"[tpu] init {cfg.name}: dim={cfg.dim} L={cfg.n_layers} "
-        f"V={cfg.vocab_size} batch={batch} ctx={max_seq} chunk={chunk}")
-    params = init_params(jax.random.PRNGKey(0), cfg)
+        f"V={cfg.vocab_size} batch={batch} ctx={max_seq} chunk={chunk} "
+        f"quant={quant or 'bf16'}")
+    if quant == "int8":
+        # Leaf-wise quantized init: 8B bf16 would not fit the chip
+        # (BASELINE config #2 is exactly why int8 exists).
+        params = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
     n_params = param_count(params)
     log(f"[tpu] {n_params/1e9:.2f}B params")
 
@@ -354,6 +384,8 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     step_ms = dt / n_tok * 1e3
     tps = batch * n_tok / dt
     peak = _peak_flops(dev.device_kind)
+    if quant == "int8":
+        peak *= 2          # v5e int8 MXU path has 2x the bf16 FLOPs
     mfu = tps * 2 * n_params / peak
     log(f"[tpu] decode: {step_ms:.2f} ms/token-step, {tps:,.0f} tok/s "
         f"(B={batch}, chunk={chunk}), MFU={mfu*100:.2f}%  | "
@@ -361,7 +393,10 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
         f"{prefill_pipe_tps:,.0f} tok/s pipelined")
     return {
         "model": cfg.name, "params_b": round(n_params / 1e9, 3),
+        "quant": quant or "bf16",
         "device": dev.device_kind, "batch": batch, "context": max_seq,
+        "page_size": page_size,
+        "host_device_rtt_ms": round(rtt_ms, 1),
         "decode_chunk": chunk,
         "decode_step_ms": round(step_ms, 3),
         "decode_tokens_per_s": round(tps, 1),
@@ -372,15 +407,17 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int) -> Optional[Dict]:
     }
 
 
-# -- 4. 4-tier Poisson against the REAL model on TPU (BASELINE #4) ------------
+# -- 4. 4-tier Poisson + offered-load sweep on the REAL model (BASELINE #4) ---
 
-def bench_poisson_tpu(model_name: str, rate_per_s: float,
-                      duration_s: float) -> Optional[Dict]:
-    """Open-loop Poisson arrivals into the jax engine on the real chip:
-    per-tier end-to-end latency with strict-priority admission and
-    step-boundary preemption live. Smaller scale than the echo run —
-    the point is SLA SHAPE (realtime p99 bounded while low tier absorbs
-    the queueing) on real decode steps, not peak throughput."""
+def bench_poisson_tpu(model_name: str, rates, duration_s: float,
+                      quant: str = "") -> Optional[Dict]:
+    """Open-loop Poisson arrivals into the jax engine on the real chip,
+    swept over offered rates: per-tier end-to-end latency with strict
+    priority admission, step-boundary preemption and pipelined decode
+    live. The sweep yields the ``sla_curve`` — the max offered rate at
+    which the realtime tier's p99 still meets the reference's 500 ms
+    load-test gate (docs/performance.md:1047-1050), scaled to one chip.
+    """
     import jax
 
     if jax.default_backend() == "cpu" and not os.environ.get(
@@ -392,55 +429,105 @@ def bench_poisson_tpu(model_name: str, rate_per_s: float,
     from llmq_tpu.engine.engine import GenRequest, InferenceEngine
     from llmq_tpu.engine.executor import JaxExecutor
     from llmq_tpu.engine.tokenizer import ByteTokenizer
-    from llmq_tpu.models.llama import get_config, init_params
+    from llmq_tpu.models.llama import (get_config, init_params,
+                                       init_params_quantized)
 
     tok = ByteTokenizer()
     cfg = get_config(model_name, max_seq_len=512)
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    slots = 8
+    if quant == "int8":
+        params = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+    slots = int(os.environ.get("LLMQ_BENCH_TPU_SLOTS", "16"))
     ex = JaxExecutor(cfg, params, batch_size=slots, page_size=16,
-                     num_pages=slots * 32 + 1, chunk_size=8,
+                     num_pages=slots * 32 + 1, chunk_size=32,
                      prefill_buckets=[64], eos_id=tok.eos_id)
-    log(f"[poisson-tpu] warmup {cfg.name} ({slots} slots) ...")
+    log(f"[poisson-tpu] warmup {cfg.name} {quant or 'bf16'} "
+        f"({slots} slots) ...")
+    t0 = time.perf_counter()
     ex.warmup()
+    log(f"[poisson-tpu] warmup {time.perf_counter() - t0:.1f}s")
     engine = InferenceEngine(ex, tok, enable_metrics=False,
                              max_decode_steps=32)
     engine.start()
 
-    rng = random.Random(7)
-    lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
-    handles = []
-    log(f"[poisson-tpu] {rate_per_s:.1f} req/s for {duration_s:.0f}s ...")
-    t_start = time.perf_counter()
-    next_arrival = t_start
-    n_sent = 0
-    while time.perf_counter() - t_start < duration_s:
-        now = time.perf_counter()
-        if now < next_arrival:
-            time.sleep(min(0.002, next_arrival - now))
-            continue
-        next_arrival += rng.expovariate(rate_per_s)
-        h = engine.submit(GenRequest(
-            id=f"pt{n_sent}", prompt=f"load test request {n_sent % 50}",
-            priority=sample_tier(rng), max_new_tokens=24))
-        handles.append(h)
-        n_sent += 1
-    # One SHARED drain deadline: a wedged engine must bound the bench,
-    # not stall it per-handle.
-    deadline = time.perf_counter() + 90.0
-    for h in handles:
-        if not h.wait(max(0.0, deadline - time.perf_counter())):
-            break
+    # Discarded warm burst: the first requests after a fresh executor
+    # (or a preceding bench section's HBM churn) pay one-time costs that
+    # would otherwise pollute the first swept rate point.
+    warm = [engine.submit(GenRequest(id=f"warm{i}", prompt="warm up",
+                                     max_new_tokens=24))
+            for i in range(8)]
+    for h in warm:
+        h.wait(60.0)
+
+    p99_gate_ms = 500.0          # reference docs/performance.md:1047
+    curve = []
+    max_ok_rate = 0.0
+    headline = None
+    for rate in rates:
+        rng = random.Random(7)
+        handles = []
+        log(f"[poisson-tpu] {rate:.1f} req/s for {duration_s:.0f}s ...")
+        t_start = time.perf_counter()
+        next_arrival = t_start
+        n_sent = 0
+        while time.perf_counter() - t_start < duration_s:
+            now = time.perf_counter()
+            if now < next_arrival:
+                time.sleep(min(0.002, next_arrival - now))
+                continue
+            next_arrival += rng.expovariate(rate)
+            h = engine.submit(GenRequest(
+                id=f"pt{rate}-{n_sent}",
+                prompt=f"load test request {n_sent % 50}",
+                priority=sample_tier(rng), max_new_tokens=24))
+            handles.append(h)
+            n_sent += 1
+        # One SHARED drain deadline: a wedged engine must bound the
+        # bench, not stall it per-handle.
+        deadline = time.perf_counter() + 90.0
+        for h in handles:
+            if not h.wait(max(0.0, deadline - time.perf_counter())):
+                break
+        # Quiesce between rate points: cancel any backlog so the next
+        # point measures ITS offered load, not a saturated predecessor's
+        # leftovers.
+        leftovers = 0
+        for h in handles:
+            if not h.done:
+                h.cancel()
+                leftovers += 1
+        if leftovers:
+            quiesce = time.perf_counter() + 30.0
+            while time.perf_counter() < quiesce:
+                s = engine.get_stats()
+                if s["pending"] == 0 and s["active"] == 0:
+                    break
+                time.sleep(0.1)
+        lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
+        completed = 0
+        for h in handles:
+            if h.done and h.result.finish_reason in ("eos", "length"):
+                completed += 1
+                lat[h.request.priority.tier_name].append(h.latency)
+        point: Dict = {"offered_rate": rate, "sent": n_sent,
+                       "completed": completed, "cancelled": leftovers}
+        tier_report(lat, point, f"poisson-tpu@{rate:g}")
+        curve.append(point)
+        rt_p99 = point["realtime"]["p99_ms"]
+        if (point["realtime"]["n"] > 0 and completed >= n_sent * 0.95
+                and rt_p99 <= p99_gate_ms):
+            max_ok_rate = rate
+        if headline is None:
+            headline = point
     engine.stop()
-    completed = 0
-    for h in handles:
-        if h.done and h.result.finish_reason in ("eos", "length"):
-            completed += 1
-            lat[h.request.priority.tier_name].append(h.latency)
-    out: Dict = {"offered_rate": rate_per_s, "sent": n_sent,
-                 "completed": completed,
-                 "decode_steps": engine.steps}
-    tier_report(lat, out, "poisson-tpu")
+    out: Dict = dict(headline or {})
+    out["decode_steps"] = engine.steps
+    out["sla_curve"] = curve
+    out["realtime_p99_gate_ms"] = p99_gate_ms
+    out["max_rate_realtime_p99_ok"] = max_ok_rate
+    log(f"[poisson-tpu] max rate with realtime p99 <= "
+        f"{p99_gate_ms:.0f}ms: {max_ok_rate:g} req/s")
     return out
 
 
@@ -450,9 +537,23 @@ def main() -> None:
     n_msgs = int(os.environ.get("LLMQ_BENCH_QUEUE_MSGS", "40000"))
     rate = float(os.environ.get("LLMQ_BENCH_POISSON_RATE", "1500"))
     secs = float(os.environ.get("LLMQ_BENCH_POISSON_SECS", "5"))
-    model = os.environ.get("LLMQ_BENCH_MODEL", "llama3-1b")
-    batch = int(os.environ.get("LLMQ_BENCH_BATCH", "64"))
+    # BASELINE config #2 as written: Llama-3-8B on the single chip —
+    # int8 weights (8 GB) + KV pool fit the 16 GB v5e; bf16 would not.
+    model = os.environ.get("LLMQ_BENCH_MODEL", "llama3-8b")
+    quant = os.environ.get("LLMQ_BENCH_QUANT", "int8")
+    if quant in ("bf16", "none"):
+        quant = ""
+    batch = int(os.environ.get("LLMQ_BENCH_BATCH", "32"))
     steps = int(os.environ.get("LLMQ_BENCH_DECODE_STEPS", "128"))
+    # The SLA sweep runs the smaller model by default: the sweep's job
+    # is the scheduling-plane curve (max rate at which realtime p99
+    # holds), measured per chip-second — LLMQ_BENCH_SLA_MODEL=llama3-8b
+    # runs it on the north-star model instead.
+    sla_model = os.environ.get("LLMQ_BENCH_SLA_MODEL", "llama3-1b")
+    sla_quant = os.environ.get("LLMQ_BENCH_SLA_QUANT", "")
+    sla_rates = [float(r) for r in os.environ.get(
+        "LLMQ_BENCH_TPU_POISSON_RATES", "2,5,10,20").split(",")]
+    sla_secs = float(os.environ.get("LLMQ_BENCH_TPU_POISSON_SECS", "15"))
 
     qres = bench_queue_throughput(n_msgs)
     tiers = bench_poisson_echo(rate, secs)
@@ -460,14 +561,12 @@ def main() -> None:
     tpu_tiers = None
     if not os.environ.get("LLMQ_BENCH_SKIP_TPU"):
         try:
-            tpu = bench_tpu_decode(model, batch, steps)
+            tpu = bench_tpu_decode(model, batch, steps, quant)
         except Exception as e:  # noqa: BLE001
             log(f"[tpu] decode bench failed: {type(e).__name__}: {e}")
         try:
-            tpu_tiers = bench_poisson_tpu(
-                model,
-                float(os.environ.get("LLMQ_BENCH_TPU_POISSON_RATE", "3")),
-                float(os.environ.get("LLMQ_BENCH_TPU_POISSON_SECS", "20")))
+            tpu_tiers = bench_poisson_tpu(sla_model, sla_rates, sla_secs,
+                                          sla_quant)
         except Exception as e:  # noqa: BLE001
             log(f"[poisson-tpu] failed: {type(e).__name__}: {e}")
 

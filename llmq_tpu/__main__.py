@@ -84,14 +84,29 @@ class App:
             # BASELINE config #3: conversation eviction frees pinned KV.
             self.engine.attach_conversation_manager(self.state_manager)
 
+        # Split-deployment transport (queueing/spool.py): consumer side
+        # pulls spooled messages into the local queues and acks results;
+        # gateway side relays drained messages out and applies acks.
+        self.spool_consumer = None
+        self.spool_producer = None
+        self.spool_collector = None
+        self._spool_relay: Optional[threading.Thread] = None
+        spool_dir = cfg.queue.spool_dir
+
         self.workers: List = []
         if with_workers:
             if self.engine is None:
                 raise ValueError("workers need an engine (use --backend echo "
                                  "for a model-free process)")
+            process_fn = self.engine.process_fn
+            self._spool_ack_failure = None
+            if spool_dir and not with_api:
+                process_fn = self._wire_spool_consumer(spool_dir)
             self.workers = self.factory.create_workers(
-                "standard", cfg.queue.worker.count, self.engine.process_fn)
+                "standard", cfg.queue.worker.count, process_fn,
+                on_permanent_failure=self._spool_ack_failure)
 
+        self.message_store = MessageStore()
         self.api: Optional[ApiServer] = None
         if with_api:
             self.api = ApiServer(
@@ -102,8 +117,10 @@ class App:
                 load_balancer=self.load_balancer,
                 resource_scheduler=self.resource_scheduler,
                 engine=self.engine,
-                message_store=MessageStore(),
+                message_store=self.message_store,
             )
+            if spool_dir and not with_workers:
+                self._wire_spool_gateway(spool_dir)
 
         self.autoscaler = None
         if with_scheduler:
@@ -112,6 +129,94 @@ class App:
                                          cfg.scheduler)
 
         self._stop = threading.Event()
+
+    # -- split-deployment spool wiring ---------------------------------------
+
+    def _wire_spool_consumer(self, spool_dir: str):
+        """Queue-manager side: spooled messages land in the local
+        queues; results (success or exhausted-retry failure) are acked
+        into done/ for the gateway. Returns the worker process_fn."""
+        from llmq_tpu.core.types import Message, MessageStatus
+        from llmq_tpu.queueing.spool import SpoolConsumer
+
+        mgr = self.factory.get_queue_manager("standard")
+        consumer = SpoolConsumer(
+            spool_dir, lambda q, m: mgr.push_message(m, q))
+        self.spool_consumer = consumer
+        inner = self.engine.process_fn
+
+        def process(ctx, msg):
+            inner(ctx, msg)
+            ack = Message.from_dict(msg.to_dict())
+            ack.status = MessageStatus.COMPLETED
+            consumer.ack_done(ack)
+
+        def ack_failure(msg, reason):
+            # Fires from EVERY permanent-failure path — synchronous
+            # error, timeout, watchdog abandonment — so the gateway
+            # always gets a terminal record (workers.on_permanent_
+            # failure seam).
+            ack = Message.from_dict(msg.to_dict())
+            ack.status = MessageStatus.FAILED
+            ack.error = reason
+            consumer.ack_done(ack)
+
+        self._spool_ack_failure = ack_failure
+        return process
+
+    def _wire_spool_gateway(self, spool_dir: str) -> None:
+        """Gateway side: a relay thread drains the local queues into the
+        spool (messages stay in-flight locally — WAL-covered across
+        restarts); the collector applies done-records so polling clients
+        see responses and queue stats see completions."""
+        from llmq_tpu.core.types import MessageStatus
+        from llmq_tpu.queueing.spool import SpoolCollector, SpoolProducer
+
+        mgr = self.factory.get_queue_manager("standard")
+        self.spool_producer = SpoolProducer(spool_dir)
+
+        def on_done(done) -> None:
+            orig = self.message_store.get(done.id)
+            if orig is not None:
+                orig.response = done.response
+                orig.error = done.error
+                orig.status = done.status
+                orig.metadata.update(done.metadata or {})
+                target = orig
+            else:
+                target = done
+            if done.status == MessageStatus.COMPLETED:
+                mgr.complete_message(target)
+            else:
+                mgr.fail_message(target, 0.0)
+
+        self.spool_collector = SpoolCollector(spool_dir, on_done)
+
+        def relay_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    batch = mgr.drain_in_priority_order(64)
+                    for m in batch:
+                        try:
+                            self.spool_producer.push(m)
+                        except OSError:
+                            # Transient fs error on the shared volume:
+                            # put the message back and retry later —
+                            # the relay must survive (a dead relay
+                            # strands every future request silently).
+                            log.exception("spool push failed; "
+                                          "requeueing %s", m.id)
+                            mgr.push_message(m)
+                            self._stop.wait(1.0)
+                            break
+                    if not batch:
+                        self._stop.wait(0.05)
+                except Exception:  # noqa: BLE001
+                    log.exception("spool relay tick failed")
+                    self._stop.wait(1.0)
+
+        self._spool_relay = threading.Thread(
+            target=relay_loop, name="spool-relay", daemon=True)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -126,6 +231,12 @@ class App:
             w.start()
         if self.autoscaler is not None:
             self.autoscaler.start()
+        if self.spool_consumer is not None:
+            self.spool_consumer.start()
+        if self.spool_collector is not None:
+            self.spool_collector.start()
+        if self._spool_relay is not None:
+            self._spool_relay.start()
         if self.api is not None:
             port = self.api.start()
             log.info("serving on %s:%d", self.cfg.server.host, port)
@@ -135,6 +246,13 @@ class App:
         log.info("shutting down ...")
         if self.api is not None:
             self.api.stop()
+        self._stop.set()                # stops the spool relay loop
+        if self.spool_consumer is not None:
+            self.spool_consumer.stop()
+        if self.spool_collector is not None:
+            self.spool_collector.stop()
+        if self._spool_relay is not None:
+            self._spool_relay.join(timeout=5.0)
         if self.autoscaler is not None:
             self.autoscaler.stop()
         self.factory.stop_all()

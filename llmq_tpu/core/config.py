@@ -126,6 +126,13 @@ class QueueConfig:
     #: with the process (SURVEY §5). With a wal_dir, pending and
     #: in-flight messages survive restarts (at-least-once redelivery).
     wal_dir: str = ""
+    #: Shared spool directory for the SPLIT deployment (gateway and
+    #: queue-manager as separate processes): the gateway relays drained
+    #: messages into the spool, the queue-manager consumes and
+    #: acknowledges them (queueing/spool.py). "" = monolith (in-process
+    #: queues). The reference's split deployment has NO transport at
+    #: all — its consumer never sees the producer's messages.
+    spool_dir: str = ""
 
 
 @dataclass

@@ -114,13 +114,17 @@ def build_engine(cfg: Config, *, name: str = "engine0",
     else:
         raise ValueError(f"unknown executor backend {ex.backend!r}")
 
+    from llmq_tpu.core.types import Priority
+    tier_max_wait = {Priority(lvl.priority): lvl.max_wait_time
+                     for lvl in cfg.queue.levels}
     engine = InferenceEngine(
         executor, tokenizer,
         name=name,
         max_decode_steps=ex.max_decode_steps,
         preemption=ex.preemption,
         kv_pin_ttl=ex.kv_pin_ttl,
-        enable_metrics=metrics_on)
+        enable_metrics=metrics_on,
+        tier_max_wait=tier_max_wait)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size)
     return engine

@@ -142,7 +142,7 @@ class _Sequence:
                  "prefilled", "order", "adopted", "prefill_ids",
                  "prefill_start", "carry", "written_ids", "rebuild",
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
-                 "first_handle")
+                 "first_handle", "eff_prio", "arrival")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -179,9 +179,16 @@ class _Sequence:
         #: on a later engine step so the ~RTT of the sync overlaps other
         #: scheduling/compute instead of serializing admission.
         self.first_handle = None
+        #: Effective priority: starts at the request's tier and is
+        #: PROMOTED one tier per elapsed multiple of the tier's
+        #: max_wait_time while pending (SLA-aware scheduling — the
+        #: reference config's per-tier max_wait, pkg/config/config.go:
+        #: 151-156, which its code never consults).
+        self.eff_prio = int(req.priority)
+        self.arrival = 0.0
 
     def sort_key(self):
-        return (int(self.req.priority), self.order)
+        return (self.eff_prio, self.order)
 
 
 class _InflightChunk:
@@ -229,6 +236,7 @@ class InferenceEngine:
         kv_pin_ttl: float = 600.0,
         enable_metrics: bool = True,
         clock: Optional[Clock] = None,
+        tier_max_wait: Optional[Dict[Priority, float]] = None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -238,6 +246,10 @@ class InferenceEngine:
         self.preemption_enabled = preemption
         self.kv_pin_ttl = kv_pin_ttl
         self._clock = clock or SYSTEM_CLOCK
+        #: Per-tier SLA bound: a pending request older than its tier's
+        #: max_wait_time is promoted one tier per elapsed multiple
+        #: (deadline-aware admission; starvation bound for low tiers).
+        self.tier_max_wait = dict(tier_max_wait or {})
         self._metrics = get_metrics() if enable_metrics else None
         # Per-engine recorder: stats must not mix spans across engines.
         self._prof = SpanRecorder()
@@ -371,6 +383,12 @@ class InferenceEngine:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    def healthy(self) -> bool:
+        """Health probe for LoadBalancer ``local://`` endpoints: alive
+        iff the engine loop is running (a stopped or crashed engine
+        advances the LB state machine to UNHEALTHY → failover)."""
+        return self.running
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -445,9 +463,36 @@ class InferenceEngine:
     def _ingest(self) -> None:
         with self._mu:
             newly, self._inbox = self._inbox, []
+        now = self._clock.now()
         for seq in newly:
+            seq.arrival = now
             heapq.heappush(self._pending,
-                           (int(seq.req.priority), seq.order, seq))
+                           (seq.eff_prio, seq.order, seq))
+        self._promote_overdue()
+
+    def _promote_overdue(self) -> None:
+        """SLA-aware tier promotion: a pending request that has waited
+        past its tier's max_wait_time gains one tier per elapsed
+        multiple (floor REALTIME), then the heap is rebuilt so admission
+        — and preemption urgency — see the promoted priority. An
+        overdue low request beats a fresh normal arrival."""
+        if not self.tier_max_wait or not self._pending:
+            return
+        now = self._clock.now()
+        changed = False
+        for _, _, seq in self._pending:
+            mw = self.tier_max_wait.get(seq.req.priority)
+            if not mw or mw <= 0:
+                continue
+            promo = int((now - seq.arrival) / mw)
+            eff = max(int(Priority.REALTIME), int(seq.req.priority) - promo)
+            if eff != seq.eff_prio:
+                seq.eff_prio = eff
+                changed = True
+        if changed:
+            self._pending = [(s.eff_prio, o, s)
+                             for (_, o, s) in self._pending]
+            heapq.heapify(self._pending)
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -527,7 +572,7 @@ class InferenceEngine:
         if release_pages:
             self._release_sequence_pages(victim)
         heapq.heappush(self._pending,
-                       (int(victim.req.priority), victim.order, victim))
+                       (victim.eff_prio, victim.order, victim))
         if self._metrics:
             self._metrics.preemptions.labels(
                 self.name, victim.req.priority.tier_name).inc()

@@ -3,3 +3,4 @@ from llmq_tpu.loadbalancer.load_balancer import (  # noqa: F401
     EndpointStatus,
     LoadBalancer,
 )
+from llmq_tpu.loadbalancer.router import EngineRouter  # noqa: F401

@@ -1,0 +1,75 @@
+"""Message → engine routing through the LoadBalancer.
+
+The missing seam in the reference: its scheduler fabricates worker URLs
+(`/root/reference/internal/scheduler/scheduler.go:299-301`) and no code
+path ever routes a drained message to an LLM endpoint chosen by its
+LoadBalancer (SURVEY §3.5). Here the seam is real: an
+:class:`EngineRouter` is a Worker ``process_fn`` that
+
+- registers any number of in-process engines as ``local://`` endpoints
+  (the probe consults ``engine.healthy()``, so a dead engine advances
+  the LB health state machine to UNHEALTHY and traffic fails over);
+- picks the endpoint per message via the configured strategy, with
+  SESSION AFFINITY on ``conversation_id`` — turns of one conversation
+  land on the engine holding its pinned KV pages (BASELINE config #3
+  across replicas);
+- feeds back per-request response time / errors (EWMA + error decay →
+  the adaptive-load strategy's signals).
+
+One router in front of N single-chip engines is the multi-engine
+scale-out story for one host; the same Endpoint records with http URLs
+front remote hosts (BASELINE config #5's LB-over-workers half).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from llmq_tpu.core.types import Message
+from llmq_tpu.loadbalancer.load_balancer import Endpoint, LoadBalancer
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("router")
+
+
+class EngineRouter:
+    def __init__(self, load_balancer: LoadBalancer) -> None:
+        self.lb = load_balancer
+        self._engines: Dict[str, object] = {}
+
+    def register_engine(self, engine, *, endpoint_id: Optional[str] = None,
+                        weight: float = 1.0,
+                        max_connections: int = 0,
+                        metadata: Optional[Dict] = None) -> Endpoint:
+        """Expose an in-process engine as a ``local://`` endpoint."""
+        eid = endpoint_id or engine.name
+        md = dict(metadata or {})
+        md["engine"] = engine
+        ep = Endpoint(id=eid, name=engine.name,
+                      url=f"local://{engine.name}", weight=weight,
+                      max_connections=max_connections, metadata=md)
+        self.lb.add_endpoint(ep)
+        self._engines[eid] = engine
+        return ep
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        """Worker seam: route one message to the least-loaded (per
+        strategy) healthy engine, with conversation affinity."""
+        session = msg.conversation_id or None
+        ep = self.lb.get_endpoint(msg, session_id=session)
+        engine = ep.metadata.get("engine")
+        if engine is None:
+            self.lb.release_endpoint(ep.id, is_error=True)
+            raise RuntimeError(
+                f"endpoint {ep.id} has no attached engine "
+                f"(url={ep.url!r}) — remote endpoints need a transport "
+                f"process_fn, not the in-process router")
+        t0 = time.perf_counter()
+        try:
+            engine.process_fn(ctx, msg)
+        except Exception:
+            self.lb.release_endpoint(ep.id, is_error=True)
+            raise
+        self.lb.release_endpoint(ep.id, time.perf_counter() - t0)
+        msg.metadata["endpoint_id"] = ep.id

@@ -350,9 +350,16 @@ def paged_decode_step(q, k_new, v_new, k_pool, v_pool, block_tables,
     Returns (attn, k_pool, v_pool).
     """
     # page_size % 8: the fused kernel writes back the 8-sublane tile
-    # holding the new row (fused_decode.py) — sub-8 pages can't.
+    # holding the new row (fused_decode.py) — sub-8 pages can't. The
+    # tile plan must also be legal for this geometry (large-GD models at
+    # big pages force an illegal sub-8 row tile — route to the split
+    # write-kernel + pooled-attention path instead).
+    from llmq_tpu.ops.pallas.fused_decode import fused_kernel_viable
+    fused_ok = (k_pool.shape[2] % 8 == 0 and fused_kernel_viable(
+        q.shape[0], k_pool.shape[2], block_tables.shape[1],
+        k_pool.shape[3], k_pool.dtype.itemsize))
     use_kernel, interpret = _kernel_route(
-        k_pool, extra_ok=k_pool.shape[2] % 8 == 0, enabled=enabled)
+        k_pool, extra_ok=fused_ok, enabled=enabled)
     if use_kernel:
         attn, (k_pool, v_pool) = _jit_fused_decode()(
             q, k_new, v_new, k_pool, v_pool, block_tables, seq_lens,

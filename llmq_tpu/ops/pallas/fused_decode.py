@@ -301,6 +301,41 @@ def _fused_kernel(
                         ).astype(out_ref.dtype)
 
 
+def _tile_plan(B: int, page_size: int, max_pages: int, GD: int,
+               itemsize: int, pages_per_chunk: int = 0):
+    """Row-tile/chunk sizing under the ~12 MB scoped-VMEM budget.
+    Returns (R, ppc) or None when no LEGAL plan exists: Mosaic requires
+    the (R, GD) blocks' second-minor dim divisible by 8 OR equal to the
+    whole array dim — so the only legal row tiles are R=8 (when it
+    divides B) and R=B (whole-array block, covers B<8 and odd B)."""
+    def kv_scratch_bytes(r_, ppc_):
+        return 2 * 2 * r_ * ppc_ * page_size * GD * itemsize
+
+    if pages_per_chunk <= 0:
+        pages_per_chunk = max(1, 256 // page_size)
+    candidates = ([8] if B % 8 == 0 and B != 8 else []) + [B]
+    for R in candidates:
+        ppc = min(pages_per_chunk, max_pages)
+        while max_pages % ppc:
+            ppc -= 1
+        while ppc > 1 and kv_scratch_bytes(R, ppc) > 12 * 2**20:
+            ppc = max(1, ppc // 2)
+            while max_pages % ppc:
+                ppc -= 1
+        if kv_scratch_bytes(R, ppc) <= 12 * 2**20:
+            return R, ppc
+    return None
+
+
+def fused_kernel_viable(B: int, page_size: int, max_pages: int, GD: int,
+                        itemsize: int = 2) -> bool:
+    """Whether the fused kernel has a legal tile plan for this geometry
+    (large-GD models at big page sizes may not — e.g. llama3-8b's
+    GD=1024 at 256-token pages forces R=4, an illegal block). Callers
+    route to the split write+attention path when False."""
+    return _tile_plan(B, page_size, max_pages, GD, itemsize) is not None
+
+
 def fused_decode_attention_pallas(
     q: jnp.ndarray,             # (B, H, D)
     k_new: jnp.ndarray,         # (B, H_kv, D) or (B, H_kv·D)
@@ -332,28 +367,14 @@ def fused_decode_attention_pallas(
     n_rep = H // Hkv
     if GD % 128:
         raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
-    R = 8
-    while B % R:
-        R //= 2
-    if pages_per_chunk <= 0:
-        pages_per_chunk = max(1, 256 // page_size)
-    ppc = min(pages_per_chunk, max_pages)
-    while max_pages % ppc:
-        ppc -= 1
-
-    def kv_scratch_bytes(r_, ppc_):
-        return (2 * 2 * r_ * ppc_ * page_size * GD
-                * k_pool.dtype.itemsize)
-
-    # Stay under the ~16 MB scoped-VMEM limit: llama3-8b's GD=1024 at
-    # the default 256-token chunk puts the KV scratch alone at 16.8 MB
-    # for R=8. Shrink the chunk first, then the row tile.
-    while ppc > 1 and kv_scratch_bytes(R, ppc) > 12 * 2**20:
-        ppc = max(1, ppc // 2)
-        while max_pages % ppc:
-            ppc -= 1
-    while R > 1 and kv_scratch_bytes(R, ppc) > 12 * 2**20:
-        R //= 2
+    plan = _tile_plan(B, page_size, max_pages, GD, k_pool.dtype.itemsize,
+                      pages_per_chunk)
+    if plan is None:
+        raise ValueError(
+            f"no legal fused-kernel tile plan for B={B} "
+            f"page_size={page_size} GD={GD} (route via "
+            f"fused_kernel_viable before calling)")
+    R, ppc = plan
     num_tiles = B // R
     num_chunks = max_pages // ppc
 

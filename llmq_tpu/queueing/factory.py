@@ -156,7 +156,8 @@ class QueueFactory:
     # -- workers (queue_factory.go:86-134) -----------------------------------
 
     def create_workers(self, manager_name: str, count: int,
-                       process_fn: ProcessFn, start: bool = True) -> List[Worker]:
+                       process_fn: ProcessFn, start: bool = True,
+                       on_permanent_failure=None) -> List[Worker]:
         with self._lock:
             entry = self._entries.get(manager_name)
         if entry is None:
@@ -170,6 +171,7 @@ class QueueFactory:
                 delayed_queue=entry.delayed,
                 dead_letter_queue=entry.dlq,
                 clock=self._clock,
+                on_permanent_failure=on_permanent_failure,
             )
             if start:
                 w.start()

@@ -273,6 +273,8 @@ class Worker:
         delayed_queue: Optional[DelayedQueue] = None,
         dead_letter_queue: Optional[DeadLetterQueue] = None,
         clock: Optional[Clock] = None,
+        on_permanent_failure: Optional[
+            Callable[[Message, str], None]] = None,
     ) -> None:
         self.name = name
         self.manager = manager
@@ -295,6 +297,11 @@ class Worker:
             self._owned_delayed = False
         self.delayed_queue = delayed_queue
         self.dead_letter_queue = dead_letter_queue
+        #: Called once per message that fails PERMANENTLY (retries
+        #: exhausted), from whichever path killed it — synchronous
+        #: error, timeout, or watchdog abandonment. The seam transports
+        #: (queueing/spool.py) use to ack failures back to a producer.
+        self.on_permanent_failure = on_permanent_failure
         self.stats = WorkerStats()
         self._sem = threading.Semaphore(self.wconfig.max_concurrent)
         self._stop = threading.Event()
@@ -531,5 +538,12 @@ class Worker:
             self.dead_letter_queue.push(msg, reason, qname)
             with self.stats._mu:
                 self.stats.dead_lettered += 1
+        if self.on_permanent_failure is not None:
+            try:
+                self.on_permanent_failure(msg, reason)
+            except Exception:  # noqa: BLE001 — a failing hook must not
+                # break the failure path itself.
+                log.exception("on_permanent_failure hook failed for %s",
+                              msg.id)
         log.warning("message %s failed permanently after %d retries: %s",
                     msg.id, msg.retry_count, reason)

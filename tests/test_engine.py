@@ -237,6 +237,50 @@ class TestConversationKV:
         eng.step()
         assert eng.cached_conversations() == ["c1"]  # touch reset the clock
 
+    def test_overdue_low_beats_fresh_normal(self):
+        """SLA-aware promotion (VERDICT r3 #9): a LOW request older than
+        its tier's max_wait_time is promoted and admitted ahead of a
+        NORMAL request that arrived later — without promotion, strict
+        (priority, arrival) order would admit the normal first."""
+        clock = FakeClock()
+        eng = make_echo_engine(
+            slots=1, clock=clock,
+            tier_max_wait={Priority.LOW: 5.0})
+        # Occupy the single slot so both contenders queue.
+        blocker = eng.submit(GenRequest(id="block", prompt="x" * 40,
+                                        priority=Priority.REALTIME))
+        eng.step()
+        assert blocker.done is False
+        low = eng.submit(GenRequest(id="low", prompt="lo",
+                                    priority=Priority.LOW))
+        eng.step()             # low is pending, slot busy
+        clock.advance(6.0)     # past LOW's max_wait → one-tier promotion
+        normal = eng.submit(GenRequest(id="norm", prompt="no",
+                                       priority=Priority.NORMAL))
+        eng.run_until_idle()
+        assert low.done and normal.done
+        # Promoted low (effective NORMAL, earlier arrival) finished
+        # before the fresh normal.
+        assert low.finished_at < normal.finished_at
+
+    def test_no_promotion_without_max_wait(self):
+        """Same scenario, no tier_max_wait: strict priority order — the
+        fresh normal beats the older low."""
+        clock = FakeClock()
+        eng = make_echo_engine(slots=1, clock=clock)
+        blocker = eng.submit(GenRequest(id="block", prompt="x" * 40,
+                                        priority=Priority.REALTIME))
+        eng.step()
+        low = eng.submit(GenRequest(id="low", prompt="lo",
+                                    priority=Priority.LOW))
+        eng.step()
+        clock.advance(6.0)
+        normal = eng.submit(GenRequest(id="norm", prompt="no",
+                                       priority=Priority.NORMAL))
+        eng.run_until_idle()
+        assert normal.finished_at < low.finished_at
+        del blocker
+
     def test_pool_pressure_evicts_lru_conversation(self):
         # 23 usable pages of 8 tokens; each conversation pins 8 pages
         # (30 prompt + 30 echo + 1), so the 16-page "big" request must
