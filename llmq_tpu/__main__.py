@@ -196,24 +196,34 @@ class App:
             while not self._stop.is_set():
                 try:
                     batch = mgr.drain_in_priority_order(64)
-                    for m in batch:
-                        try:
-                            self.spool_producer.push(m)
-                        except OSError:
-                            # Transient fs error on the shared volume:
-                            # put the message back and retry later —
-                            # the relay must survive (a dead relay
-                            # strands every future request silently).
-                            log.exception("spool push failed; "
-                                          "requeueing %s", m.id)
-                            mgr.push_message(m)
-                            self._stop.wait(1.0)
-                            break
-                    if not batch:
-                        self._stop.wait(0.05)
                 except Exception:  # noqa: BLE001
-                    log.exception("spool relay tick failed")
+                    log.exception("spool relay drain failed")
                     self._stop.wait(1.0)
+                    continue
+                # On ANY push failure, requeue the whole undelivered
+                # remainder — drained messages are out of the queue, and
+                # dropping them strands their clients in PROCESSING
+                # forever. The relay itself must survive (a dead relay
+                # silently strands every future request).
+                undelivered = []
+                for i, m in enumerate(batch):
+                    try:
+                        self.spool_producer.push(m)
+                    except Exception:  # noqa: BLE001
+                        log.exception(
+                            "spool push failed; requeueing %d messages",
+                            len(batch) - i)
+                        undelivered = batch[i:]
+                        break
+                for m in undelivered:
+                    try:
+                        mgr.push_message(m)
+                    except Exception:  # noqa: BLE001
+                        log.exception("requeue of %s failed", m.id)
+                if undelivered:
+                    self._stop.wait(1.0)
+                elif not batch:
+                    self._stop.wait(0.05)
 
         self._spool_relay = threading.Thread(
             target=relay_loop, name="spool-relay", daemon=True)

@@ -340,6 +340,11 @@ class JaxExecutor:
             # speculative next chunk resumes it from the carried
             # tok/pos with a fresh budget).
             frozen0 = done_in
+            # 2 decode steps per loop iteration: halves the while-loop's
+            # per-iteration control overhead (~0.3 ms/step at 1B B=64 on
+            # v5e); budgets stay EXACT via the per-step active mask —
+            # only the early-exit granularity coarsens to 2.
+            UNROLL = 2 if K % 2 == 0 else 1
 
             def cond(st):
                 j, _, _, _, frozen, _ = st
@@ -347,22 +352,24 @@ class JaxExecutor:
 
             def body(st):
                 j, cache, tok, pos, frozen, out = st
-                active = (~frozen) & (j < budgets)
-                logits, cache = forward_decode(
-                    params, cfg, tok, pos, cache, block_tables,
-                    active=active)
-                nxt = sample_token(logits, keys[j],
-                                   temperature=temperatures,
-                                   top_k=top_k, top_p=top_p)
-                emit = jnp.where(active, nxt, eos).astype(jnp.int32)
-                out = jax.lax.dynamic_update_slice(
-                    out, emit[:, None], (0, j))
-                # Budget-paused rows keep their last REAL token — it is
-                # the next chunk's input; only active rows advance.
-                tok = jnp.where(active, nxt.astype(jnp.int32), tok)
-                pos = pos + active.astype(jnp.int32)
-                frozen = frozen | (active & (nxt == eos))
-                return (j + 1, cache, tok, pos, frozen, out)
+                for u in range(UNROLL):
+                    active = (~frozen) & (j + u < budgets)
+                    logits, cache = forward_decode(
+                        params, cfg, tok, pos, cache, block_tables,
+                        active=active)
+                    nxt = sample_token(logits, keys[j + u],
+                                       temperature=temperatures,
+                                       top_k=top_k, top_p=top_p)
+                    emit = jnp.where(active, nxt, eos).astype(jnp.int32)
+                    out = jax.lax.dynamic_update_slice(
+                        out, emit[:, None], (0, j + u))
+                    # Budget-paused rows keep their last REAL token —
+                    # it is the next chunk's input; only active rows
+                    # advance.
+                    tok = jnp.where(active, nxt.astype(jnp.int32), tok)
+                    pos = pos + active.astype(jnp.int32)
+                    frozen = frozen | (active & (nxt == eos))
+                return (j + UNROLL, cache, tok, pos, frozen, out)
 
             _, cache, tok, pos, frozen, out = jax.lax.while_loop(
                 cond, body,

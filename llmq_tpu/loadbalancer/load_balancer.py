@@ -98,7 +98,12 @@ class Endpoint:
             "total_requests": self.total_requests,
             "total_errors": self.total_errors,
             "load": self.load,
-            "metadata": self.metadata,
+            # JSON-safe subset only: local:// endpoints carry the live
+            # engine OBJECT in metadata (the health probe's contract) —
+            # serializing it would 500 every endpoint-listing route.
+            "metadata": {k: v for k, v in self.metadata.items()
+                         if isinstance(v, (str, int, float, bool,
+                                           type(None), list, dict))},
         }
 
 

@@ -75,18 +75,25 @@ def _fused_kernel(
     write_page_ref,     # (B,) int32 — pool page id for the current token
     layer_ref,          # (1,) int32
     # inputs
-    q_ref,              # (R, H, GD) VMEM — block-diagonal, this tile
+    q_ref,              # (R, H, D) VMEM — RAW query heads; the
+                        # block-diagonal GQA layout is built in VMEM
+                        # scratch once per tile (an H×GD q in HBM cost
+                        # ~0.3 ms/step of pure traffic at B=64)
     k_new_ref,          # (R, GD) VMEM — this tile's current K rows
     v_new_ref,          # (R, GD) VMEM
-    bias_ref,           # (R, 1, H, S) bf16 — 0 live, -1e30 masked
+    bias_ref,           # (R, 1, 8, S) bf16 — 0 live, -1e30 masked; 8
+                        # identical sublane rows (min tile), broadcast
+                        # to H in-register (ADVICE r3: an H-wide bias
+                        # was 4x the HBM traffic for H=32)
     k_hbm,              # (L, P, ps, GD) ANY — aliased to output 1
     v_hbm,              # (L, P, ps, GD) ANY — aliased to output 2
     # outputs
-    out_ref,            # (R, H, GD) VMEM — attention output, this tile
+    out_ref,            # (R, H, D) VMEM — attention output, this tile
     k_out,              # aliased pools (all DMAs target these)
     v_out,
     # scratch
     m_ref, l_ref, acc_ref,          # (R,H,1),(R,H,1),(R,H,GD) f32
+    qbd_ref,                        # (R, H, GD) VMEM — block-diag q
     k_scratch, v_scratch,           # (2, R, ppc, ps, GD) VMEM
     state,                          # SMEM (1,) int32
     sem,                            # DMA (2, 2) — [pool, slot] fetches
@@ -97,6 +104,7 @@ def _fused_kernel(
     page_size: int,
     num_chunks: int,
     batch: int,
+    n_rep: int,
     scale: float,
 ):
     t = pl.program_id(0)
@@ -176,6 +184,15 @@ def _fused_kernel(
         m_ref[...] = jnp.full_like(m_ref, -1e29)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
+        # Build the block-diagonal GQA q for this tile: group g's
+        # queries live in GD columns [g·D, (g+1)·D) so ONE batched
+        # matmul serves all heads against the (S, GD) page layout.
+        qbd_ref[...] = jnp.zeros_like(qbd_ref)
+        D = q_ref.shape[2]
+        Hkv = q_ref.shape[1] // n_rep
+        for g in range(Hkv):
+            qbd_ref[:, g * n_rep:(g + 1) * n_rep, g * D:(g + 1) * D] = (
+                q_ref[:, g * n_rep:(g + 1) * n_rep, :])
 
     c_last = tile_c_last(t)
     fetched = c <= c_last
@@ -242,7 +259,7 @@ def _fused_kernel(
 
         S = chunk_tokens
         GD = acc_ref.shape[2]
-        q = q_ref[...]                                  # (R, H, GD)
+        q = qbd_ref[...]                                # (R, H, GD)
         k = k_scratch[slot].reshape(R, S, GD)
         v = v_scratch[slot].reshape(R, S, GD)
         # Batched over the tile: contract GD, batch dim R. Operands stay
@@ -253,7 +270,12 @@ def _fused_kernel(
             q, k, dims,
             preferred_element_type=jnp.float32) * scale   # (R, H, S)
         H = acc_ref.shape[1]
-        logits = logits + bias_ref[...].reshape(R, H, S).astype(jnp.float32)
+        # The bias carries 8 identical sublane rows; take one and let
+        # the VPU broadcast it across the H query heads (same values —
+        # liveness varies only per (row, position)).
+        bias = bias_ref[...].reshape(R, 8, S)[:, :1, :]
+        logits = logits + jnp.broadcast_to(
+            bias.astype(jnp.float32), (R, H, S))
 
         m_prev = m_ref[...]
         l_prev = l_ref[...]
@@ -296,9 +318,16 @@ def _fused_kernel(
     def _():
         # Zero guard: a seq_len == 0 row computes no chunk, leaving l at
         # 0 — emit 0 (matching the other paged kernels) instead of 0/0.
-        out_ref[...] = (acc_ref[...]
-                        / jnp.maximum(l_ref[...], 1e-30)
-                        ).astype(out_ref.dtype)
+        res = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)  # (R,H,GD)
+        # Un-blockdiagonal: group g's heads only populated columns
+        # [g·D, (g+1)·D) — emit the compact (R, H, D) directly (the
+        # old H×GD output cost another ~0.3 ms/step of HBM traffic).
+        D = out_ref.shape[2]
+        Hkv = out_ref.shape[1] // n_rep
+        for g in range(Hkv):
+            out_ref[:, g * n_rep:(g + 1) * n_rep, :] = res[
+                :, g * n_rep:(g + 1) * n_rep,
+                g * D:(g + 1) * D].astype(out_ref.dtype)
 
 
 def _tile_plan(B: int, page_size: int, max_pages: int, GD: int,
@@ -378,40 +407,41 @@ def fused_decode_attention_pallas(
     num_tiles = B // R
     num_chunks = max_pages // ppc
 
-    eye = jnp.eye(Hkv, dtype=q.dtype)
-    q_bd = jnp.einsum("bgrd,gh->bgrhd", q.reshape(B, Hkv, n_rep, D),
-                      eye).reshape(B, H, GD)
-    # Additive mask, chunk-blocked: (B, num_chunks, H, S) with 0 on
+    # q goes in RAW (B, H, D); the kernel builds the block-diagonal GQA
+    # layout in VMEM (the old HBM-materialized H×GD q + H×GD output
+    # cost ~0.6 ms/step of pure traffic at B=64, H=32).
+    # Additive mask, chunk-blocked: (B, num_chunks, 8, S) with 0 on
     # positions < seq_len and -1e30 beyond (built here because Mosaic
-    # can't stack SMEM scalars into vectors; H broadcast because the
-    # block's last-two dims must be tile-aligned; bf16 because its
-    # exponent range covers -1e30 at half the HBM traffic).
+    # can't stack SMEM scalars into vectors; 8 identical sublane rows —
+    # the MINIMUM tile-aligned height, broadcast to H inside the kernel
+    # — instead of H copies: at H=32 that is 4x less bias HBM traffic;
+    # bf16 because its exponent range covers -1e30 at half the bytes).
     S = ppc * page_size
     pos_all = (jnp.arange(num_chunks * S, dtype=jnp.int32)
                .reshape(1, num_chunks, 1, S))
     bias = jnp.where(pos_all < seq_lens.reshape(B, 1, 1, 1),
                      0.0, NEG_INF).astype(jnp.bfloat16)
-    bias = jnp.broadcast_to(bias, (B, num_chunks, H, S))
+    bias = jnp.broadcast_to(bias, (B, num_chunks, 8, S))
     kn = k_new.reshape(B, GD).astype(k_pool.dtype)
     vn = v_new.reshape(B, GD).astype(v_pool.dtype)
 
     kernel = functools.partial(
         _fused_kernel, rows_per_tile=R, pages_per_chunk=ppc,
         page_size=page_size, num_chunks=num_chunks, batch=B,
-        scale=D ** -0.5)
+        n_rep=n_rep, scale=D ** -0.5)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(num_tiles, num_chunks),
         in_specs=[
-            pl.BlockSpec((R, H, GD), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
             pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
             pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
-            pl.BlockSpec((R, 1, H, S), lambda t, c, *_: (t, c, 0, 0)),
+            pl.BlockSpec((R, 1, 8, S), lambda t, c, *_: (t, c, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((R, H, GD), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
@@ -419,6 +449,7 @@ def fused_decode_attention_pallas(
             pltpu.VMEM((R, H, 1), jnp.float32),
             pltpu.VMEM((R, H, 1), jnp.float32),
             pltpu.VMEM((R, H, GD), jnp.float32),
+            pltpu.VMEM((R, H, GD), q.dtype),
             pltpu.VMEM((2, R, ppc, page_size, GD), k_pool.dtype),
             pltpu.VMEM((2, R, ppc, page_size, GD), v_pool.dtype),
             pltpu.SMEM((1,), jnp.int32),
@@ -426,14 +457,14 @@ def fused_decode_attention_pallas(
             pltpu.SemaphoreType.DMA((2, R)),
         ],
     )
-    # Operands: 4 scalar-prefetch, then q_bd, kn, vn, bias, pools →
+    # Operands: 4 scalar-prefetch, then q, kn, vn, bias, pools →
     # pool operands 8/9 alias outputs 1/2. Pools are ALREADY flat
     # (L, P, ps, GD) — any reshape here would break XLA's aliasing and
     # copy both pools every call (see init_kv_pages).
     out, k_out, v_out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((B, H, GD), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((B, H, D), q.dtype),
                    jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
                    jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
         input_output_aliases={8: 1, 9: 2},
@@ -443,8 +474,5 @@ def fused_decode_attention_pallas(
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       write_page.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
-      q_bd, kn, vn, bias, k_pool, v_pool)
-    out5 = out.reshape(B, Hkv, n_rep, Hkv, D)
-    attn = jnp.einsum("bgrhd,gh->bgrd", out5,
-                      jnp.eye(Hkv, dtype=out.dtype)).reshape(B, H, D)
-    return attn.astype(q.dtype), (k_out, v_out)
+      q, kn, vn, bias, k_pool, v_pool)
+    return out.astype(q.dtype), (k_out, v_out)

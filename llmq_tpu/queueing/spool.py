@@ -153,6 +153,12 @@ class SpoolConsumer:
         tmp = done + f".tmp-{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(msg.to_dict(), default=str))
+            f.flush()
+            # The spool is the durability boundary on BOTH legs: after
+            # processing, the consumer's WAL won't redeliver — losing
+            # this record to a crash would wedge the gateway's message
+            # in PROCESSING forever.
+            os.fsync(f.fileno())
         os.rename(tmp, done)
 
     def _reclaim_stale(self) -> None:

@@ -61,9 +61,9 @@ def step_body(p, c, tok, pos, *, attn_mode="full", head=True, samp=True):
         hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
         if attn_mode == "attnonly":
             qkv = linear(hn, layer_slice(lp["wk"], l))
-            q = jnp.broadcast_to(
+            q = jnp.repeat(
                 qkv.reshape(B, 1, cfg.n_kv_heads, cfg.head_dim),
-                (B, 1, cfg.n_heads, cfg.head_dim))
+                cfg.n_heads // cfg.n_kv_heads, axis=2)
             k = qkv.reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             v = k
         else:

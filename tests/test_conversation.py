@@ -229,6 +229,69 @@ class _FakeRedis:
         pass
 
 
+def _real_redis():
+    """A live Redis (client lib + reachable server) or None. The CI
+    workflow runs a redis:7 service so TestRedisStoreReal executes
+    there; locally it skips when no server is up."""
+    try:
+        import redis
+    except ImportError:
+        return None
+    try:
+        client = redis.Redis.from_url("redis://localhost:6379/0",
+                                      socket_connect_timeout=0.3,
+                                      socket_timeout=0.5)
+        client.ping()
+        return client
+    except Exception:  # noqa: BLE001 — any failure means "unavailable"
+        return None
+
+
+@pytest.mark.skipif(_real_redis() is None,
+                    reason="no real redis server/client available")
+class TestRedisStoreReal:
+    """The SAME contract as TestRedisStore, against a REAL server
+    (VERDICT r3 #10): exercises actual RESP encoding, server-side TTLs
+    and set semantics the in-memory double can only approximate."""
+
+    @pytest.fixture
+    def rstore(self):
+        from llmq_tpu.conversation.persistence import RedisStore
+        client = _real_redis()
+        store = RedisStore("redis://localhost:6379/0",
+                           prefix="llmq-test:", ttl=60.0, client=client)
+        yield store
+        for k in client.scan_iter("llmq-test:*"):
+            client.delete(k)
+        store.close()
+
+    def test_roundtrip_and_user_index(self, rstore):
+        c = Conversation(id="cr1", user_id="u1")
+        c.add_message("hello", "hi there")
+        rstore.save(c)
+        back = rstore.load("cr1")
+        assert back is not None
+        assert back.id == "cr1" and back.user_id == "u1"
+        assert back.messages[0].content == "hello"
+        assert rstore.list_user("u1") == ["cr1"]
+
+    def test_delete_removes_blob_and_membership(self, rstore):
+        for cid in ("ca", "cb"):
+            rstore.save(Conversation(id=cid, user_id="u2"))
+        rstore.delete("ca")
+        assert rstore.load("ca") is None
+        assert rstore.list_user("u2") == ["cb"]
+        assert rstore.load("cb") is not None
+
+    def test_server_side_ttl_set(self, rstore):
+        rstore.save(Conversation(id="ct", user_id="u3"))
+        client = _real_redis()
+        ttl = client.ttl("llmq-test:ct")
+        assert 0 < ttl <= 60
+        uttl = client.ttl("llmq-test:user:u3")
+        assert 0 < uttl <= 60
+
+
 class TestRedisStore:
     """RedisStore against an injected in-memory client: exercises the
     reference's key scheme (persistence.go:46-82) — {prefix}{conv_id}
