@@ -241,6 +241,9 @@ class ExecutorConfig:
     # on-device for this many tokens, amortizing host↔device latency.
     # Also the engine's admission/preemption granularity.
     decode_chunk: int = 16
+    # Prompts per batched-prefill program: an admission wave streams the
+    # weights once for up to this many prompts' chunks.
+    prefill_batch: int = 4
     preemption: bool = True
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
 

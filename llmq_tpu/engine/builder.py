@@ -108,6 +108,7 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             prefill_buckets=list(ex.prefill_buckets),
             eos_id=tokenizer.eos_id,
             chunk_size=ex.decode_chunk,
+            prefill_batch=ex.prefill_batch,
             mesh=mesh)
         if warmup:
             executor.warmup()

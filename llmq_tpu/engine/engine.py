@@ -821,32 +821,62 @@ class InferenceEngine:
         # one cycle instead of one-sequence-per-step. Sync executors
         # keep the single most-urgent pick.
         cands.sort(key=lambda s: s.sort_key())
-        if prefill_async is None:
-            cands = cands[:1]
+        prefill_multi = getattr(self.executor, "prefill_multi_async",
+                                None)
+        npf = getattr(self.executor, "prefill_batch", 1)
+        use_multi = (prefill_multi is not None and npf > 1
+                     and len(cands) > 1)
+        if prefill_async is None and not use_multi:
+            cands = cands[:1]               # sync executor: one per step
+
+        # Pop one bucket-chunk per candidate (shared by every dispatch
+        # path — the accounting below must stay identical between them).
+        work = []
         for seq in cands:
             chunk_len = buckets[-1] if buckets else len(seq.todo_ids)
             chunk = seq.todo_ids[:chunk_len]
             seq.todo_ids = seq.todo_ids[chunk_len:]
+            work.append((seq, chunk))
+
+        handles: List = [None] * len(work)
+        if use_multi:
+            # Batched admission waves: npf prompts' chunks per program
+            # (weights stream once per wave); ALL waves dispatch this
+            # step — the programs just queue on the device.
+            for i0 in range(0, len(work), npf):
+                grp = work[i0:i0 + npf]
+                with self._prof.span("engine.prefill_multi",
+                                     seqs=len(grp),
+                                     tokens=sum(len(c) for _, c in grp)):
+                    hs = prefill_multi(
+                        [(chunk, seq.todo_pos, seq.block_table,
+                          seq.req.temperature) for seq, chunk in grp])
+                handles[i0:i0 + len(grp)] = hs
+        elif prefill_async is not None:
+            for i, (seq, chunk) in enumerate(work):
+                with self._prof.span("engine.prefill",
+                                     tokens=len(chunk)):
+                    handles[i] = prefill_async(chunk, seq.todo_pos,
+                                               seq.block_table,
+                                               seq.req.temperature)
+        else:
+            seq, chunk = work[0]
             with self._prof.span("engine.prefill", tokens=len(chunk)):
-                if prefill_async is not None:
-                    handle = prefill_async(chunk, seq.todo_pos,
-                                           seq.block_table,
-                                           seq.req.temperature)
-                    first = None
-                else:
-                    first = self.executor.prefill(chunk, seq.todo_pos,
-                                                  seq.block_table,
-                                                  seq.req.temperature,
-                                                  seq.slot)
+                first = self.executor.prefill(chunk, seq.todo_pos,
+                                              seq.block_table,
+                                              seq.req.temperature,
+                                              seq.slot)
+
+        for (seq, chunk), handle in zip(work, handles):
             seq.todo_pos += len(chunk)
             seq.pos = seq.todo_pos
             seq.written_ids.extend(chunk)
             if seq.todo_ids:
                 continue                    # more buckets next step
-            if first is None:
+            if handle is not None:
                 seq.first_handle = handle   # fetched next step
-                continue
-            self._complete_prefill(seq, first)
+            else:
+                self._complete_prefill(seq, first)
         return True
 
     def _resolve_prefills(self) -> bool:

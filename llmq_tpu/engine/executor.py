@@ -219,7 +219,8 @@ class JaxExecutor:
                  prefill_buckets: Optional[List[int]] = None,
                  top_k: int = 0, top_p: float = 1.0, eos_id: int = 2,
                  cache_dtype=None, seed: int = 0,
-                 chunk_size: int = 16, mesh=None) -> None:
+                 chunk_size: int = 16, prefill_batch: int = 4,
+                 mesh=None) -> None:
         import jax
         import jax.numpy as jnp
         from functools import partial
@@ -228,9 +229,15 @@ class JaxExecutor:
             forward_decode, forward_prefill, init_kv_pages)
         from llmq_tpu.ops.sampling import sample_token
 
+        import dataclasses as _dc
+
         self._jax = jax
         self._jnp = jnp
         self.mesh = mesh
+        # Serving context: forward-only programs, so the batched-prefill
+        # kernels are safe here (the flag keeps them away from the
+        # differentiated training path, which shares forward_prefill).
+        model_cfg = _dc.replace(model_cfg, pallas_batched_prefill=True)
         if mesh is not None and mesh.size > 1:
             import dataclasses
 
@@ -253,6 +260,11 @@ class JaxExecutor:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
         self.chunk_size = max(1, chunk_size)
+        #: Sequences per batched-prefill program (admission waves run
+        #: their prompts through ONE program: the dense matmuls — where
+        #: the weight streaming is — batch across prompts; the
+        #: per-sequence KV-write/attention kernels row-loop inside).
+        self.prefill_batch = max(1, min(prefill_batch, batch_size))
         self.prefill_buckets = sorted(prefill_buckets or [32, 128, 512])
         if self._kv_shardings is not None:
             # Create the pool ALREADY sharded (out_shardings) — a 70B
@@ -298,6 +310,21 @@ class JaxExecutor:
             tok = sample_token(last, key, temperature=temperature,
                                top_k=top_k, top_p=top_p)
             return tok[0], cache
+
+        @jit_step
+        def _prefill_multi(params, cache, tokens, positions, lengths,
+                           block_tables, temperatures, key):
+            """Batched prefill: N prompts' chunks through one program —
+            per-row last-token sampling; padded rows (length ≤ 1,
+            all-zero block table) write only reserved page 0."""
+            logits, cache = forward_prefill(
+                params, cfg, tokens, positions, lengths, cache,
+                block_tables)
+            idx = jnp.arange(tokens.shape[0])
+            last = logits[idx, lengths - 1]            # (N, V)
+            toks = sample_token(last, key, temperature=temperatures,
+                                top_k=top_k, top_p=top_p)
+            return toks, cache
 
         @jit_step
         def _decode_step(params, cache, tokens, positions, block_tables,
@@ -377,6 +404,7 @@ class JaxExecutor:
             return out, tok, pos, frozen, cache
 
         self._prefill_step = _prefill_step
+        self._prefill_multi = _prefill_multi
         self._decode_step = _decode_step
         self._decode_chunk = _decode_chunk
         #: AOT-compiled executables by program name (filled by warmup;
@@ -434,11 +462,18 @@ class JaxExecutor:
         i32, f32 = jnp.int32, jnp.float32
 
         jobs = []
+        NPF = self.prefill_batch
         for T in self.prefill_buckets:
             jobs.append((f"prefill_b{T}", self._prefill_step,
                          (p, c, sds((1, T), i32), sds((1, T), i32),
                           sds((1,), i32), sds((1, MP), i32),
                           sds((1,), f32), key)))
+            if NPF > 1:
+                jobs.append((f"prefill_multi_b{T}", self._prefill_multi,
+                             (p, c, sds((NPF, T), i32),
+                              sds((NPF, T), i32), sds((NPF,), i32),
+                              sds((NPF, MP), i32), sds((NPF,), f32),
+                              key)))
         jobs.append(("decode", self._decode_step,
                      (p, c, sds((B,), i32), sds((B,), i32),
                       sds((B, MP), i32), sds((B,), f32), key)))
@@ -528,6 +563,37 @@ class JaxExecutor:
         if tok is None:
             return spec.eos_id
         return int(tok)
+
+    def prefill_multi_async(self, reqs: List) -> List:
+        """Prefill up to ``prefill_batch`` prompts' chunks in ONE
+        program dispatch (no host sync): the weight streaming of the
+        dense path is paid once for the whole admission wave instead of
+        per sequence. ``reqs``: (tokens, start_pos, block_table,
+        temperature) per sequence, each chunk ≤ the largest bucket.
+        Returns one device scalar (sampled first token) per request.
+        """
+        jnp = self._jnp
+        N = self.prefill_batch
+        assert 0 < len(reqs) <= N, len(reqs)
+        T = self._bucket_for(max(len(t) for t, _, _, _ in reqs))
+        toks = np.zeros((N, T), np.int32)
+        poss = np.zeros((N, T), np.int32)
+        lens = np.ones(N, np.int32)    # pad rows: 1 trash token → page 0
+        bts = np.zeros((N, self.spec.max_pages_per_seq), np.int32)
+        temps = np.zeros(N, np.float32)
+        for i, (t, sp, bt, temp) in enumerate(reqs):
+            toks[i, :len(t)] = t
+            poss[i] = np.minimum(sp + np.arange(T), sp + len(t) - 1)
+            lens[i] = len(t)
+            bts[i] = bt
+            temps[i] = temp
+        fn = self._aot.get(f"prefill_multi_b{T}", self._prefill_multi)
+        with annotate(f"prefill_multi_b{T}"):
+            out, self.cache = fn(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(poss), jnp.asarray(lens), jnp.asarray(bts),
+                jnp.asarray(temps), self._next_key())
+        return [out[i] for i in range(len(reqs))]
 
     def prefill_async(self, tokens: List[int], start_pos: int,
                       block_table: np.ndarray, temperature: float):

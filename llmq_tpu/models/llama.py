@@ -61,6 +61,12 @@ class LlamaConfig:
     #: partition a Pallas call, so sharded programs must trace the
     #: pure-JAX paths it CAN partition (static — part of the jit key).
     pallas: bool = True
+    #: Allow the PREFILL kernels for B > 1 (row-looped inside the
+    #: program). Only the serving executor sets this: the kernels have
+    #: no VJP, and the training/loss path runs forward_prefill with
+    #: B > 1 under jax.grad — it must keep the differentiable pure-JAX
+    #: route (B == 1 serving prefill is kernel-eligible either way).
+    pallas_batched_prefill: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -325,12 +331,14 @@ def forward_prefill(
         # Write this layer's KV into its slice of the pool.
         k_pool, v_pool = paged_kv_write_prefill(
             k_pool, v_pool, k, v, block_tables, positions, lengths,
-            jnp.int32(l), enabled=cfg.pallas)
+            jnp.int32(l), enabled=cfg.pallas,
+            multi_ok=cfg.pallas_batched_prefill)
         # Attend over the full paged history (covers continuation turns);
         # causality enforced via absolute positions.
         attn = dispatch_prefill_attention(q, k_pool, v_pool, block_tables,
                                           positions, seq_lens, l,
-                                          enabled=cfg.pallas)
+                                          enabled=cfg.pallas,
+                                          multi_ok=cfg.pallas_batched_prefill)
         h = h + linear(attn.reshape(B, T, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),

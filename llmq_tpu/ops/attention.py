@@ -250,7 +250,8 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_of, slot_of, layer,
 
 
 def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
-                           lengths, layer, *, enabled: bool = True):
+                           lengths, layer, *, enabled: bool = True,
+                           multi_ok: bool = False):
     """Write a prefill chunk's KV (k/v: (B, T, H_kv, D)) into layer
     ``layer`` of the stacked pool.
 
@@ -266,25 +267,35 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
     B, T = k.shape[0], k.shape[1]
     page_size = k_pool.shape[2]
     GD = k_pool.shape[3]
-    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1),
-                                          enabled=enabled)
+    # B > 1 only via the serving executor's batched-prefill opt-in
+    # (multi_ok): the kernels have no VJP, and the B > 1 training path
+    # must keep the differentiable fallback.
+    use_kernel, interpret = _kernel_route(
+        k_pool, extra_ok=(B == 1 or multi_ok), enabled=enabled)
     if use_kernel:
-        start = positions[0, 0]
-        n_tok = lengths[0]
-        # Buffer must hold max_offset (page_size-1) + T rows, rounded to
-        # whole pages — T//page_size + 1 under-allocates for non-multiple
-        # buckets and dynamic_update_slice would silently clamp.
+        # The write kernel is per-sequence; B > 1 (batched prefill)
+        # chains one aliased call per row through the pool — the dense
+        # matmuls around this are what batching amortizes.
+        fn = _jit_kv_prefill_write()
         n_wp = -(-T // page_size) + 1
-        aligned_k = jnp.zeros((n_wp * page_size, GD), k.dtype)
-        aligned_v = jnp.zeros((n_wp * page_size, GD), v.dtype)
-        off = start % page_size
-        aligned_k = jax.lax.dynamic_update_slice(
-            aligned_k, k[0].reshape(T, GD), (off, 0))
-        aligned_v = jax.lax.dynamic_update_slice(
-            aligned_v, v[0].reshape(T, GD), (off, 0))
-        return _jit_kv_prefill_write()(
-            k_pool, v_pool, aligned_k, aligned_v, block_tables[0],
-            start, n_tok, layer, interpret=interpret)
+        for b in range(B):
+            start = positions[b, 0]
+            n_tok = lengths[b]
+            # Buffer must hold max_offset (page_size-1) + T rows,
+            # rounded to whole pages — T//page_size + 1 under-allocates
+            # for non-multiple buckets and dynamic_update_slice would
+            # silently clamp.
+            aligned_k = jnp.zeros((n_wp * page_size, GD), k.dtype)
+            aligned_v = jnp.zeros((n_wp * page_size, GD), v.dtype)
+            off = start % page_size
+            aligned_k = jax.lax.dynamic_update_slice(
+                aligned_k, k[b].reshape(T, GD), (off, 0))
+            aligned_v = jax.lax.dynamic_update_slice(
+                aligned_v, v[b].reshape(T, GD), (off, 0))
+            k_pool, v_pool = fn(
+                k_pool, v_pool, aligned_k, aligned_v, block_tables[b],
+                start, n_tok, layer, interpret=interpret)
+        return k_pool, v_pool
     # Scatter coordinates: padding rows (beyond lengths) → page 0.
     valid = (jnp.arange(T)[None, :] < lengths[:, None])     # (B, T)
     flat_valid = valid.reshape(-1)
@@ -300,8 +311,8 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
 
 
 def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
-                               seq_lens, layer, *,
-                               enabled: bool = True) -> jnp.ndarray:
+                               seq_lens, layer, *, enabled: bool = True,
+                               multi_ok: bool = False) -> jnp.ndarray:
     """Prefill-chunk attention over the paged pool; q (B, T, H, D).
 
     B == 1 on TPU: Pallas paged prefill kernel reading the pool
@@ -320,13 +331,17 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
     """
     B, T = q.shape[0], q.shape[1]
     page_size = k_pool.shape[2]
-    use_kernel, interpret = _kernel_route(k_pool, extra_ok=(B == 1),
-                                          enabled=enabled)
+    use_kernel, interpret = _kernel_route(
+        k_pool, extra_ok=(B == 1 or multi_ok), enabled=enabled)
     if use_kernel:
-        out = _jit_prefill_attention()(
-            q[0], k_pool, v_pool, block_tables[0], positions[0, 0],
-            layer, interpret=interpret)
-        return out[None]
+        # Per-sequence kernel, row-looped for batched prefill: pure
+        # READS of the pool — B opaque kernel consumers don't make XLA
+        # copy it (only a gather between aliased writes does).
+        fn = _jit_prefill_attention()
+        outs = [fn(q[b], k_pool, v_pool, block_tables[b],
+                   positions[b, 0], layer, interpret=interpret)
+                for b in range(B)]
+        return outs[0][None] if B == 1 else jnp.stack(outs)
     S = block_tables.shape[1] * page_size
     D = q.shape[3]
     Hkv = k_pool.shape[3] // D

@@ -554,6 +554,42 @@ class TestJaxEngine:
         if piped.finish_reason == "length":
             assert len(piped.tokens) == 20
 
+    def test_batched_prefill_matches_sequential(self, tiny_model):
+        """An admission wave through the batched-prefill program
+        (prefill_batch=4) must produce exactly the tokens the
+        one-sequence-per-program path produces, including a
+        continuation turn over cached KV."""
+        cfg, params = tiny_model
+        tok = ByteTokenizer()
+        prompts = ["alpha prompt one", "beta two", "gamma three is longer",
+                   "delta", "epsilon five"]
+
+        def run(npf):
+            ex = JaxExecutor(cfg, params, batch_size=8, page_size=8,
+                             num_pages=128, prefill_buckets=[16, 64],
+                             eos_id=tok.eos_id, chunk_size=4,
+                             prefill_batch=npf)
+            eng = InferenceEngine(ex, tok, enable_metrics=False,
+                                  max_decode_steps=6)
+            hs = [eng.submit(GenRequest(id=f"r{i}", prompt=p,
+                                        conversation_id=f"c{i}",
+                                        max_new_tokens=6))
+                  for i, p in enumerate(prompts)]
+            eng.run_until_idle()
+            first = [h.result.tokens for h in hs]
+            # Turn 2: continuation prefill over the cached KV.
+            h2 = eng.submit(GenRequest(id="t2", prompt=" more",
+                                       conversation_id="c0",
+                                       max_new_tokens=6))
+            eng.run_until_idle()
+            assert h2.result.cached_tokens > 0
+            return first, h2.result.tokens
+
+        batched, b2 = run(4)
+        single, s2 = run(1)
+        assert batched == single
+        assert b2 == s2
+
     def test_greedy_matches_reference(self, tiny_model):
         cfg, params = tiny_model
         eng = make_jax_engine(tiny_model)

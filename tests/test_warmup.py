@@ -32,8 +32,9 @@ class TestWarmup:
         ex.warmup()
         # Loud failure if the AOT pass fell back: every program must be
         # present (a spec/signature drift would leave _aot empty).
-        assert set(ex._aot) == {"prefill_b16", "prefill_b32", "decode",
-                                "decode_chunk"}, set(ex._aot)
+        assert set(ex._aot) == {"prefill_b16", "prefill_b32",
+                                "prefill_multi_b16", "prefill_multi_b32",
+                                "decode", "decode_chunk"}, set(ex._aot)
 
         # Serving goes through the executables and matches the jit path.
         bt = np.zeros((4, ex.spec.max_pages_per_seq), np.int32)
