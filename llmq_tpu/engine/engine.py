@@ -842,9 +842,19 @@ class InferenceEngine:
         if use_multi:
             # Batched admission waves: npf prompts' chunks per program
             # (weights stream once per wave); ALL waves dispatch this
-            # step — the programs just queue on the device.
+            # step — the programs just queue on the device. A trailing
+            # singleton uses the cheaper single-prefill program instead
+            # of an NPF-row padded batch.
             for i0 in range(0, len(work), npf):
                 grp = work[i0:i0 + npf]
+                if len(grp) == 1 and prefill_async is not None:
+                    seq, chunk = grp[0]
+                    with self._prof.span("engine.prefill",
+                                         tokens=len(chunk)):
+                        handles[i0] = prefill_async(
+                            chunk, seq.todo_pos, seq.block_table,
+                            seq.req.temperature)
+                    continue
                 with self._prof.span("engine.prefill_multi",
                                      seqs=len(grp),
                                      tokens=sum(len(c) for _, c in grp)):

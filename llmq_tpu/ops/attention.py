@@ -255,9 +255,10 @@ def paged_kv_write_prefill(k_pool, v_pool, k, v, block_tables, positions,
     """Write a prefill chunk's KV (k/v: (B, T, H_kv, D)) into layer
     ``layer`` of the stacked pool.
 
-    B == 1 on TPU (the executor prefills one sequence per call): Pallas
-    page-RMW kernel — the chunk touches T/page_size contiguous pages,
-    each merged and written with two DMAs instead of T ~13µs scatter
+    TPU kernel path (B == 1, or any B with the serving executor's
+    ``multi_ok`` batched-prefill opt-in — row-looped aliased calls):
+    Pallas page-RMW kernel — each chunk touches T/page_size contiguous
+    pages, merged and written with two DMAs instead of T ~13µs scatter
     rows. The chunk's KV is first shifted into a page-aligned buffer
     (token t at row ``start%page_size + t``) with ONE contiguous
     dynamic-update-slice so the kernel only needs static block slices.
@@ -315,11 +316,14 @@ def dispatch_prefill_attention(q, k_pool, v_pool, block_tables, positions,
                                multi_ok: bool = False) -> jnp.ndarray:
     """Prefill-chunk attention over the paged pool; q (B, T, H, D).
 
-    B == 1 on TPU: Pallas paged prefill kernel reading the pool
-    directly — an XLA gather between the layers' aliased KV-writes
-    makes XLA insert full-pool defensive copies (measured 3-4x total
-    prefill cost), and the gather also materializes the padded window.
-    Fallback: gather + blockwise online-softmax attention.
+    TPU kernel path (B == 1, or any B with ``multi_ok`` — per-row
+    kernel reads don't break the pool aliasing): Pallas paged prefill
+    kernel reading the pool directly — an XLA gather between the
+    layers' aliased KV-writes makes XLA insert full-pool defensive
+    copies (measured 3-4x total prefill cost), and the gather also
+    materializes the padded window. Without the opt-in, B > 1 (the
+    differentiated training path — the kernels have no VJP) falls back
+    to gather + blockwise online-softmax attention.
 
     CONTIGUITY REQUIREMENT (kernel path): ``positions`` rows must be
     contiguous — the kernel derives every q position as

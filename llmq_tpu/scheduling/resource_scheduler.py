@@ -271,8 +271,12 @@ class ResourceScheduler:
             # request.timeout bounds PENDING wait only; the allocation's
             # lifetime is always the configured allocation_timeout (reusing
             # the former for the latter would reclaim a resource out from
-            # under a live caller).
+            # under a live caller). metadata {"pinned": True} opts out of
+            # expiry entirely — the holder is a long-lived occupant (a
+            # serving engine's chips) released only explicitly.
             timeout = self.config.allocation_timeout
+            if request.metadata.get("pinned"):
+                timeout = 0.0
             alloc = ResourceAllocation(
                 id=str(uuid.uuid4()),
                 resource_id=chosen.id,

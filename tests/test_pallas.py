@@ -241,6 +241,49 @@ class TestKvWriteKernels:
             np.asarray(out_cache["k"][:, 1:5]),
             np.asarray(ref_cache["k"][:, 1:5]), atol=3e-2, rtol=3e-2)
 
+    def test_batched_prefill_kernel_route_interpret(self, monkeypatch):
+        """B>1 forward_prefill with the serving executor's
+        pallas_batched_prefill opt-in routes the row-looped kernels
+        (interpret mode) and matches the pure-JAX path — the production
+        batched-admission route (r4), otherwise only exercised on TPU."""
+        import dataclasses
+
+        from llmq_tpu.models.llama import (forward_prefill, get_config,
+                                           init_kv_pages, init_params)
+        cfg = get_config("llama3-tiny", max_seq_len=64, dim=256,
+                         n_heads=4, n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        B, T = 3, 8
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(1, 500, (B, T)), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+        lens = jnp.asarray([8, 5, 8], jnp.int32)
+        bt = jnp.asarray(np.arange(1, B * 4 + 1, dtype=np.int32)
+                         .reshape(B, 4))
+        monkeypatch.setenv("LLMQ_PALLAS", "0")
+        jax.clear_caches()
+        cache = init_kv_pages(cfg, 16, 8)
+        ref_logits, ref_cache = forward_prefill(params, cfg, toks, pos,
+                                                lens, cache, bt)
+        monkeypatch.setenv("LLMQ_PALLAS", "interpret")
+        jax.clear_caches()
+        kcfg = dataclasses.replace(cfg, pallas_batched_prefill=True)
+        cache = init_kv_pages(cfg, 16, 8)
+        out_logits, out_cache = forward_prefill(params, kcfg, toks, pos,
+                                                lens, cache, bt)
+        jax.clear_caches()
+        # Compare only VALID rows' logits (padding rows differ — the
+        # kernel derives q positions from positions[b, 0] and discards
+        # nothing; the executor slices at lengths-1).
+        for b in range(B):
+            n = int(lens[b])
+            np.testing.assert_allclose(
+                np.asarray(out_logits[b, :n]),
+                np.asarray(ref_logits[b, :n]), atol=3e-2, rtol=3e-2)
+        np.testing.assert_allclose(
+            np.asarray(out_cache["k"][:, 1:13]),
+            np.asarray(ref_cache["k"][:, 1:13]), atol=3e-2, rtol=3e-2)
+
 
 class TestFusedDecode:
     def test_matches_unfused(self, monkeypatch):
