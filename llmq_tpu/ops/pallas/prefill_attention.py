@@ -195,20 +195,23 @@ def paged_prefill_attention_pallas(
         ppc -= 1
 
     def vmem_est(qb_, ppc_):
-        # f32 acc/m/l + double-buffered KV scratch + q/out blocks.
+        # f32 acc/m/l + double-buffered KV scratch + q/out BLOCKS —
+        # Mosaic DOUBLE-BUFFERS grid in/out blocks, so q and out each
+        # cost 2 buffers (undercounting this OOM'd scoped vmem for
+        # GD=1024 models: 16.94M vs the 16M limit).
         acc = qb_ * H * (GD + 2) * 4
         kv = 2 * 2 * ppc_ * page_size * GD * k_pool.dtype.itemsize
-        qo = 2 * qb_ * H * GD * q.dtype.itemsize
+        qo = 2 * 2 * qb_ * H * GD * q.dtype.itemsize
         return acc + kv + qo
 
     # Stay under the ~16 MB VMEM scoped limit with headroom: shrink the
     # KV chunk first (large pages made the default 8-page chunk 2 MB+
     # per buffer), then the q block.
-    while ppc > 1 and vmem_est(qb, ppc) > 12 * 2**20:
+    while ppc > 1 and vmem_est(qb, ppc) > 10 * 2**20:
         ppc = max(1, ppc // 2)
         while max_pages % ppc:
             ppc -= 1
-    while qb > 8 and vmem_est(qb, ppc) > 12 * 2**20:
+    while qb > 8 and vmem_est(qb, ppc) > 10 * 2**20:
         qb //= 2
         while T % qb:
             qb -= 1
