@@ -427,7 +427,7 @@ class InferenceEngine:
         # chunk is still decoding — are deferred while one is in
         # flight; see _admit/_alloc_pages).
         resolved = self._resolve_prefills()
-        admitted = self._admit()
+        admitted = self._admit()       # free slots only while in flight
         prefilled = self._advance_prefill()
         if self._chunk_inflight is not None:
             infl = self._chunk_inflight
@@ -438,8 +438,20 @@ class InferenceEngine:
             self._process_chunk(infl)
             self._chunk_inflight = nxt
             if nxt is None:
-                # Geometry changed or work was pending: assemble the
-                # next chunk fresh from the just-reconciled state.
+                # Reconciled: re-run admission NOW, when preemption and
+                # page-shedding are legal again (the pre-reconcile
+                # _admit above skips them while rows are in flight —
+                # without this second pass an urgent arrival could
+                # never displace a decoding sequence, because each step
+                # ends with a fresh chunk in flight). The extra prefill
+                # pass runs ONLY when this admission actually seated
+                # someone (its first bucket shouldn't wait a cycle);
+                # unconditional, it would double the one-bucket-per-step
+                # bound for every mid-prefill sequence.
+                if self._admit():
+                    self._advance_prefill()
+                # Then assemble the next chunk fresh from the
+                # just-reconciled state.
                 self._decode_once()
             self._set_gauges()
             return True

@@ -554,6 +554,39 @@ class TestJaxEngine:
         if piped.finish_reason == "length":
             assert len(piped.tokens) == 20
 
+    def test_preemption_defers_while_chunk_inflight(self, tiny_model):
+        """Pipelined executor: a realtime arrival while low-tier chunks
+        are in flight must still preempt and finish first — preemption
+        is DEFERRED to the reconcile (never applied to rows the device
+        is still decoding), not dropped."""
+        cfg, params = tiny_model
+        tok = ByteTokenizer()
+        ex = JaxExecutor(cfg, params, batch_size=1, page_size=8,
+                         num_pages=64, prefill_buckets=[16, 64],
+                         eos_id=tok.eos_id, chunk_size=4)
+        eng = InferenceEngine(ex, tok, enable_metrics=False,
+                              max_decode_steps=40)
+        low = eng.submit(GenRequest(id="low", prompt="background work",
+                                    priority=Priority.LOW,
+                                    max_new_tokens=40))
+        # Steps until a chunk is in flight for the low request.
+        for _ in range(50):
+            eng.step()
+            if eng._chunk_inflight is not None:
+                break
+        assert eng._chunk_inflight is not None
+        rt = eng.submit(GenRequest(id="rt", prompt="urgent",
+                                   priority=Priority.REALTIME,
+                                   max_new_tokens=4))
+        eng.run_until_idle()
+        assert rt.result.finish_reason in ("eos", "length")
+        assert low.result.finish_reason in ("eos", "length")
+        # The realtime request finished BEFORE the preempted low one.
+        assert rt.finished_at < low.finished_at
+        # And the preempted low request still produced its full output.
+        if low.result.finish_reason == "length":
+            assert len(low.result.tokens) == 40
+
     def test_batched_prefill_matches_sequential(self, tiny_model):
         """An admission wave through the batched-prefill program
         (prefill_batch=4) must produce exactly the tokens the
