@@ -535,6 +535,20 @@ class InferenceEngine:
 
     def _admit(self) -> bool:
         admitted = False
+        #: Entries popped because their conversation's previous turn is
+        #: still live — re-queued after the loop. SKIPPED, not a
+        #: head-of-line break: the holder may itself be PENDING (it was
+        #: preempted mid-turn) and sorted BEHIND this more urgent turn —
+        #: breaking would deadlock the whole engine (found by the
+        #: randomized soak: every slot idle, 35 requests pending,
+        #: forever). Capacity stays RESERVED for the most urgent blocked
+        #: turn: entries less urgent than it are deferred (old
+        #: head-of-line semantics) — except the blocked conversations'
+        #: own holders, which must seat to unblock their waiters.
+        conv_blocked = []
+        deferred = []
+        blocked_floor = None
+        blocked_holders = set()
         while self._pending:
             prio, order, seq = self._pending[0]
             if seq.handle.cancelled:
@@ -545,9 +559,23 @@ class InferenceEngine:
             if conv:
                 holder = self._conv_busy.get(conv)
                 if holder is not None and holder != seq.order:
-                    # One live sequence per conversation (turn ordering):
-                    # strict-priority head-of-line wait.
-                    break
+                    # One live sequence per conversation (turn order):
+                    # this turn waits — but only THIS turn.
+                    heapq.heappop(self._pending)
+                    conv_blocked.append((prio, order, seq))
+                    if blocked_floor is None or (prio, order) < blocked_floor:
+                        blocked_floor = (prio, order)
+                    blocked_holders.add(holder)
+                    continue
+            if (blocked_floor is not None and (prio, order) > blocked_floor
+                    and seq.order not in blocked_holders):
+                # Less urgent than a blocked conversation turn: don't
+                # seat it in front (unbounded inversion when preemption
+                # is off) — but keep scanning, the blocked turn's
+                # holder may be deeper in the heap.
+                heapq.heappop(self._pending)
+                deferred.append((prio, order, seq))
+                continue
             slot = self._free_slot()
             if (slot is None and self.preemption_enabled
                     and self._chunk_inflight is None):
@@ -569,6 +597,10 @@ class InferenceEngine:
                 heapq.heappush(self._pending, (prio, order, seq))
                 break
             admitted = True
+        for entry in conv_blocked:
+            heapq.heappush(self._pending, entry)
+        for entry in deferred:
+            heapq.heappush(self._pending, entry)
         return admitted
 
     def _preempt(self, victim: _Sequence, release_pages: bool) -> None:
