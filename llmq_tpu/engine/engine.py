@@ -1114,13 +1114,33 @@ class InferenceEngine:
         B = self.spec.batch_size
         chunk = max(1, getattr(self.executor, "chunk_size", 1))
         chunk = min(chunk, self._admission_cap())
+        start_fn = (getattr(self.executor, "decode_chunk_start", None)
+                    if chunk > 1 else None)
         active = [s for s in self._slots
                   if s is not None and s.prefilled]
-        if not active:
+        # Same-step decode JOIN: a sequence whose final prefill chunk is
+        # dispatched-but-unresolved can enter THIS chunk — its sampled
+        # first token is fed device-to-device (lane override), never
+        # waiting out the resolve round-trip. Its admission completes at
+        # the next _resolve_prefills, which always runs before this
+        # chunk is processed, so commit order stays first-token-then-row
+        # (an EOS first token finishes the sequence there and the row is
+        # discarded; the garbage KV it wrote lands in pages that any
+        # later owner rewrites before reading). Rebuild-resume rows are
+        # excluded — their replayed first sample is discarded by design.
+        joining = []
+        if start_fn is not None:
+            joining = [s for s in self._slots
+                       if s is not None and not s.prefilled
+                       and s.first_handle is not None
+                       and not s.todo_ids and s.todo_resume is None
+                       and not s.todo_rebuild
+                       and not s.handle.cancelled]
+        if not active and not joining:
             self._set_gauges()
             return False
         budgets_by_order: Dict[int, int] = {}
-        for seq in list(active):
+        for seq in list(active) + joining:
             if seq.slot is None:
                 continue  # shed by an earlier sequence's page allocation
             if seq.handle.cancelled:
@@ -1130,7 +1150,13 @@ class InferenceEngine:
                 self._finish_active(seq, "length")  # block table exhausted
                 continue
             budget = self._budget_for(seq, chunk)
-            if not self._ensure_decode_pages(seq, budget):
+            if not seq.prefilled:
+                # Joining row: the resolve will commit the
+                # prefill-sampled token FIRST, so the row may emit one
+                # fewer (0 latches the row — harmless; its admission
+                # still completes at resolve).
+                budget = max(0, budget - 1)
+            if budget and not self._ensure_decode_pages(seq, budget):
                 # Pool exhausted even after shedding everyone else:
                 # requeue this one rather than truncating its output.
                 if seq.slot is not None:  # may have been shed already
@@ -1139,7 +1165,10 @@ class InferenceEngine:
             budgets_by_order[seq.order] = budget
         active = [s for s in self._slots
                   if s is not None and s.prefilled]
-        if not active:
+        joining = [s for s in joining
+                   if s.slot is not None and s.first_handle is not None
+                   and s.order in budgets_by_order]
+        if not active and not joining:
             self._set_gauges()
             return False
 
@@ -1148,24 +1177,29 @@ class InferenceEngine:
         block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
         temps = np.zeros(B, np.float32)
         budgets = np.zeros(B, np.int32)
-        for seq in active:
+        overrides = []
+        for seq in active + joining:
             i = seq.slot
-            tokens[i] = seq.last_token
+            # Joining rows' input token is a device scalar (their
+            # prefill's sample); the host placeholder is overridden.
+            if seq.prefilled:
+                tokens[i] = seq.last_token
+            else:
+                overrides.append((i, seq.first_handle))
             positions[i] = seq.pos
             block_tables[i] = seq.block_table
             temps[i] = seq.req.temperature
             budgets[i] = budgets_by_order.get(seq.order, 1)
-        start_fn = (getattr(self.executor, "decode_chunk_start", None)
-                    if chunk > 1 else None)
         if start_fn is not None:
             # Pipelined: dispatch only — tokens are fetched on the NEXT
             # step (possibly after the next chunk is already running).
             with self._prof.span("engine.decode_dispatch",
-                                 active=len(active), chunk=chunk):
+                                 active=len(active), chunk=chunk,
+                                 joined=len(joining)):
                 handle = start_fn(tokens, positions, block_tables, temps,
-                                  budgets)
+                                  budgets, overrides=overrides)
             seqs = [None] * B
-            for seq in active:
+            for seq in active + joining:
                 seqs[seq.slot] = seq
             self._chunk_inflight = _InflightChunk(handle, seqs, budgets)
             self.steps += 1
@@ -1209,6 +1243,16 @@ class InferenceEngine:
             seq.slot = None
         conv = seq.req.conversation_id
         if conv and reason in ("eos", "length"):
+            # Trim pages past the written length before pinning: decode
+            # budgets allocate ahead (and a joined row that finished at
+            # resolve wrote only garbage there) — pinning them would
+            # hold pool capacity for KV no turn will ever read.
+            keep = PageAllocator.pages_for(seq.pos, self.spec.page_size)
+            if len(seq.pages) > keep:
+                extra = seq.pages[keep:]
+                seq.pages = seq.pages[:keep]
+                seq.block_table[keep:keep + len(extra)] = 0
+                self.allocator.free(extra)
             with self._mu:
                 if conv in self._conv_drop_pending:
                     self._conv_drop_pending.discard(conv)

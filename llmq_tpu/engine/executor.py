@@ -624,7 +624,8 @@ class JaxExecutor:
                            block_tables: np.ndarray,
                            temperatures: np.ndarray,
                            budgets: np.ndarray,
-                           carry: Optional["ChunkHandle"] = None
+                           carry: Optional["ChunkHandle"] = None,
+                           overrides: Optional[List] = None
                            ) -> "ChunkHandle":
         """Dispatch one chunk WITHOUT a host sync.
 
@@ -633,7 +634,13 @@ class JaxExecutor:
         the prior chunk's end state, no host round-trip on the critical
         path (pipelined decode: the engine fetches ``carry.out`` while
         this chunk runs). Without it, inputs come from host arrays and
-        no row starts latched."""
+        no row starts latched.
+
+        ``overrides`` — (slot, device_scalar) pairs whose input token
+        comes DEVICE-to-device (a just-prefilled sequence's sampled
+        first token joins the batch without ever visiting the host:
+        same-step decode join, one pipeline cycle saved per request).
+        """
         jnp = self._jnp
         fn = self._aot.get("decode_chunk", self._decode_chunk)
         if carry is not None:
@@ -642,6 +649,8 @@ class JaxExecutor:
             tok_in = jnp.asarray(tokens, jnp.int32)
             pos_in = jnp.asarray(positions, jnp.int32)
             done_in = jnp.zeros(self.spec.batch_size, bool)
+        for slot, tok_dev in (overrides or ()):
+            tok_in = tok_in.at[slot].set(tok_dev.astype(jnp.int32))
         with annotate("decode_chunk"):
             out, tok, pos, done, self.cache = fn(
                 self.params, self.cache,
