@@ -173,9 +173,36 @@ class App:
             log.exception("chip allocation failed; engine runs anyway")
             return
         self.engine_allocation = alloc
+        self._start_chip_heartbeat()
         log.info("engine %s holds %d chip(s) of %s (%.0f GB HBM total)",
                  self.engine.name, n_chips, topo.slice_name,
                  topo.total_hbm_gb)
+
+    def _start_chip_heartbeat(self) -> None:
+        """Keep the registered chip resources ALIVE while the engine is:
+        the scheduler's monitor marks resources offline on heartbeat
+        timeout (reference :477-492 semantics), and a serving process
+        that registers chips but never heartbeats them reports its own
+        chips offline 30 s in. The engine's liveness IS the heartbeat
+        signal — a dead engine thread stops the beat and the scheduler
+        correctly ages its chips out."""
+        import threading
+
+        sched = self.resource_scheduler
+        interval = max(1.0, sched.config.heartbeat_timeout / 3.0)
+
+        def beat() -> None:
+            while not self._hb_stop.wait(interval):
+                if self.engine is None or not self.engine.running:
+                    continue
+                for r in sched.resources():
+                    if "tpu" in r.capabilities:
+                        sched.heartbeat(r.id)
+
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=beat, daemon=True,
+                                           name="chip-heartbeat")
+        self._hb_thread.start()
 
     # -- split-deployment spool wiring ---------------------------------------
 
@@ -301,6 +328,8 @@ class App:
     def stop(self) -> None:
         """Shutdown cascade mirroring cmd/server/main.go:109-118."""
         log.info("shutting down ...")
+        if getattr(self, "_hb_stop", None) is not None:
+            self._hb_stop.set()
         if self.api is not None:
             self.api.stop()
         self._stop.set()                # stops the spool relay loop

@@ -37,8 +37,8 @@ from llmq_tpu import __version__
 from llmq_tpu.api.message_store import MessageStore
 from llmq_tpu.core.config import Config, default_config
 from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
-from llmq_tpu.core.types import (ConversationState, Message, Priority,
-                                 new_id)
+from llmq_tpu.core.types import (ConversationState, Message,
+                                 MessageStatus, Priority, new_id)
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("api")
@@ -48,6 +48,30 @@ _WAIT_TABLE = {Priority.REALTIME: 1.0, Priority.HIGH: 5.0,
                Priority.NORMAL: 15.0, Priority.LOW: 30.0}
 
 Handler = Callable[["_Request"], Tuple[int, Any]]
+
+
+class _Deadline:
+    """Minimal ProcessContext stand-in for the sync-generate RPC: the
+    engine's worker seam only consults ``remaining()``."""
+
+    def __init__(self, secs: float) -> None:
+        self._deadline = time.monotonic() + secs
+
+    def remaining(self) -> float:
+        return self._deadline - time.monotonic()
+
+
+class _SSEStream:
+    """Dispatch payload marker: iterate and write each yielded string as
+    it is produced (``text/event-stream``), instead of buffering one
+    JSON body. Events must already be SSE-framed
+    (``event:.../data:...\\n\\n``)."""
+
+    def __init__(self, events) -> None:
+        self.events = events
+
+    def __iter__(self):
+        return iter(self.events)
 
 
 class ApiError(Exception):
@@ -149,6 +173,7 @@ class ApiServer:
         r("GET", f"{v1}/endpoints", self.list_endpoints)
         r("GET", f"{v1}/endpoints/stats", self.get_endpoint_stats)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
+        r("POST", f"{v1}/generate", self.generate_sync)
         adm = f"{v1}/admin"
         r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
         r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
@@ -187,6 +212,8 @@ class ApiServer:
                 return 500, {"error": f"internal error: {e}"}, "application/json"
             if isinstance(payload, bytes):
                 return status, payload, "text/plain; version=0.0.4"
+            if isinstance(payload, _SSEStream):
+                return status, payload, "text/event-stream"
             return status, payload, "application/json"
         if matched_path:
             return 405, {"error": "method not allowed"}, "application/json"
@@ -280,13 +307,150 @@ class ApiServer:
         return 200, exposition()
 
     def submit_message(self, req: _Request) -> Tuple[int, Any]:
-        msg = self._ingest_message(req.json())
+        data = req.json()
+        if data.pop("stream", False):
+            return self._stream_message(data)
+        msg = self._ingest_message(data)
         return 202, {
             "message_id": msg.id,
             "priority": int(msg.priority),
             "queue_time": time.time(),
             "estimated_wait": self.estimate_wait(msg.priority),
         }
+
+    def _stream_message(self, data: Dict[str, Any]) -> Tuple[int, Any]:
+        """``POST /api/v1/messages`` with ``"stream": true`` — token
+        streaming over SSE (SURVEY §7 bridge design: "tokens-out +
+        streaming"). The message bypasses the queue plane and goes
+        straight to the engine with an ``on_token`` subscription: the
+        user-perceived metric for a realtime tier is FIRST-token
+        latency, and a queue→worker→blocking-process_fn round cannot
+        surface tokens before completion. The message is still
+        recorded in the store and the conversation updated, so the
+        query API sees streamed messages like queued ones."""
+        if self.engine is None:
+            raise ApiError(503, "streaming requires an attached engine")
+        from queue import Empty, Queue
+
+        from llmq_tpu.engine.engine import GenRequest
+
+        # Read the CLIENT's timeout before Message.from_dict fills the
+        # dataclass default (30 s) — an unset field must get the
+        # streaming default, not be silently capped at 30 s.
+        explicit_timeout = data.get("timeout")
+        try:
+            msg = Message.from_dict(data)
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"invalid message: {e}") from None
+        if not msg.id:
+            msg.id = new_id()
+        msg.created_at = msg.updated_at = time.time()
+        if self.preprocessor is not None:
+            msg = self.preprocessor.process_message(msg)
+        msg.status = MessageStatus.PROCESSING
+        self.store.record(msg)
+        if msg.conversation_id and self.state_manager is not None:
+            try:
+                self.state_manager.add_message(msg.conversation_id, msg)
+            except Exception:  # noqa: BLE001 — parity: log, don't fail
+                log.exception("conversation update failed for %s", msg.id)
+
+        tokens: "Queue[int]" = Queue()
+        handle = self.engine.submit(GenRequest.from_message(msg),
+                                    on_token=tokens.put)
+        tokenizer = self.engine.tokenizer
+        timeout = (float(explicit_timeout)
+                   if explicit_timeout and float(explicit_timeout) > 0
+                   else 120.0)
+
+        def events():
+            yield ("event: start\ndata: "
+                   + json.dumps({"message_id": msg.id,
+                                 "priority": int(msg.priority)})
+                   + "\n\n")
+            ids: List[int] = []
+            sent = ""
+            deadline = time.monotonic() + timeout
+
+            def drain_delta(final: bool = False) -> str:
+                nonlocal sent
+                # Cumulative decode then slice: per-id decode would
+                # break multi-byte/multi-token graphemes at chunk
+                # boundaries. Trailing U+FFFD is HELD BACK mid-stream:
+                # it usually marks a multi-byte sequence whose tail
+                # lands in the next burst — emitting it would lock the
+                # mangled char into the stream (the cumulative decode
+                # later fixes it, but the prefix was already sent).
+                # The final flush emits everything (a real invalid
+                # byte stays a replacement char).
+                full = tokenizer.decode(ids)
+                safe = full
+                if not final:
+                    while safe and safe[-1] == "�":
+                        safe = safe[:-1]
+                if len(safe) < len(sent):
+                    return ""
+                delta, sent = safe[len(sent):], safe
+                return delta
+
+            try:
+                while True:
+                    try:
+                        ids.append(tokens.get(timeout=0.05))
+                    except Empty:
+                        if handle.done:
+                            break
+                        if time.monotonic() > deadline:
+                            handle.cancel()
+                            break
+                        continue
+                    while not tokens.empty():   # commit bursts → one event
+                        ids.append(tokens.get_nowait())
+                    delta = drain_delta()
+                    if delta:
+                        yield ("data: " + json.dumps({"token": delta})
+                               + "\n\n")
+                handle.wait(5.0)
+                while not tokens.empty():
+                    ids.append(tokens.get_nowait())
+                delta = drain_delta(final=True)
+                if delta:
+                    yield "data: " + json.dumps({"token": delta}) + "\n\n"
+                res = handle.result
+                first_ms = None
+                if "first_token" in handle.marks:
+                    first_ms = round((handle.marks["first_token"]
+                                      - handle.submitted_at) * 1e3, 1)
+                msg.response = res.text if res else sent
+                msg.status = (MessageStatus.COMPLETED
+                              if res and res.finish_reason in
+                              ("eos", "length") else MessageStatus.FAILED)
+                msg.updated_at = time.time()
+                done = {
+                    "message_id": msg.id,
+                    "finish_reason": res.finish_reason if res else "timeout",
+                    "first_token_ms": first_ms,
+                    "usage": {
+                        "prompt_tokens": res.prompt_tokens if res else 0,
+                        "completion_tokens": len(res.tokens) if res else 0,
+                    },
+                }
+                yield "event: done\ndata: " + json.dumps(done) + "\n\n"
+            except GeneratorExit:
+                # Client went away mid-stream: stop generating for it
+                # and close out the stored record (it must not sit in
+                # PROCESSING forever — eviction prefers terminal
+                # messages, so a stuck live record is near-immortal).
+                handle.cancel()
+                msg.status = MessageStatus.FAILED
+                msg.updated_at = time.time()
+                raise
+            except Exception:  # noqa: BLE001 — mid-stream failure
+                handle.cancel()
+                msg.status = MessageStatus.FAILED
+                msg.updated_at = time.time()
+                raise
+        return 200, _SSEStream(events())
 
     def get_message(self, req: _Request) -> Tuple[int, Any]:
         msg = self.store.get(req.params["id"])
@@ -454,6 +618,33 @@ class ApiServer:
             raise ApiError(503, "engine not configured")
         return 200, self.engine.get_stats()
 
+    def generate_sync(self, req: _Request) -> Tuple[int, Any]:
+        """Synchronous inference RPC — the server half of the
+        remote-engine transport (loadbalancer/transport.py): a peer
+        host's router/worker POSTs a drained message here and gets the
+        completion back in the response. This is the dispatch seam the
+        reference invents worker URLs for but never implements
+        (scheduler.go:299-301 fabricates ``http://llm-processor-N``;
+        nothing ever calls them)."""
+        if self.engine is None:
+            raise ApiError(503, "no engine attached to this process")
+        data = req.json()
+        timeout = float(data.pop("timeout", 0) or 120.0)
+        try:
+            msg = Message.from_dict(data)
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"invalid message: {e}") from None
+        if not msg.id:
+            msg.id = new_id()
+        try:
+            self.engine.process_fn(_Deadline(timeout), msg)
+        except TimeoutError as e:
+            raise ApiError(504, str(e)) from None
+        except RuntimeError as e:
+            raise ApiError(500, f"generation failed: {e}") from None
+        return 200, {"message_id": msg.id, "response": msg.response,
+                     "usage": msg.metadata.get("usage", {})}
+
     # -- admin ---------------------------------------------------------------
 
     def add_priority_rule(self, req: _Request) -> Tuple[int, Any]:
@@ -543,6 +734,30 @@ class ApiServer:
                 body = self.rfile.read(length) if length else b""
                 status, payload, ctype = server.dispatch(
                     self.command, self.path, body)
+                if isinstance(payload, _SSEStream):
+                    # Streaming: chunked, flushed per event; length
+                    # unknown up front, so close delimits the body.
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self._cors_headers()
+                    self.end_headers()
+                    try:
+                        for event in payload:
+                            self.wfile.write(event.encode("utf-8"))
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass   # client hung up
+                    finally:
+                        # Deterministic cleanup: closing the generator
+                        # raises GeneratorExit inside it → the stream
+                        # cancels its engine request.
+                        close = getattr(payload.events, "close", None)
+                        if close is not None:
+                            close()
+                    self.close_connection = True
+                    return
                 try:
                     data = (payload if isinstance(payload, bytes)
                             else json.dumps(payload).encode())

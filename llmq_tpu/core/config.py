@@ -224,6 +224,10 @@ class ModelConfig:
     # the weight HBM footprint/bandwidth — the only way llama3-8B fits a
     # single 16 GB v5e chip (BASELINE config #2).
     quantization: str = ""
+    # "" | "int8": quantized KV cache (per-token-per-head scales,
+    # ops/quant.py int8-KV section): halves the pool bytes and the
+    # decode step's KV read traffic — 8B serves B=64 instead of B=32.
+    kv_quantization: str = ""
     max_seq_len: int = 2048
     vocab_size: int = 0                 # 0 → model default
 

@@ -42,6 +42,7 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             chunk_size=ex.decode_chunk)
     elif ex.backend == "jax":
         import jax
+        import jax.numpy as jnp
 
         from llmq_tpu.models.llama import get_config, init_params
         from llmq_tpu.models.checkpoint import import_hf_llama, load_checkpoint
@@ -64,6 +65,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         quant = getattr(cfg.model, "quantization", "")
         if quant not in ("", "int8"):
             raise ValueError(f"unknown model.quantization {quant!r} "
+                             f"(supported: 'int8')")
+        kv_quant = getattr(cfg.model, "kv_quantization", "")
+        if kv_quant not in ("", "int8"):
+            raise ValueError(f"unknown model.kv_quantization {kv_quant!r} "
                              f"(supported: 'int8')")
         if params is None:
             path = cfg.model.checkpoint_path
@@ -109,6 +114,7 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             eos_id=tokenizer.eos_id,
             chunk_size=ex.decode_chunk,
             prefill_batch=ex.prefill_batch,
+            cache_dtype=(jnp.int8 if kv_quant == "int8" else None),
             mesh=mesh)
         if warmup:
             executor.warmup()

@@ -250,7 +250,10 @@ class JaxExecutor:
             params = shard_params(
                 params, param_shardings(model_cfg, mesh,
                                         quantized=quantized))
-            self._kv_shardings = kv_cache_shardings(model_cfg, mesh)
+            self._kv_shardings = kv_cache_shardings(
+                model_cfg, mesh,
+                quantized=(jnp.dtype(cache_dtype or model_cfg.dtype)
+                           == jnp.int8))
         else:
             self._kv_shardings = None
         self.model_cfg = model_cfg
@@ -260,6 +263,8 @@ class JaxExecutor:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
         self.chunk_size = max(1, chunk_size)
+        self._top_k = top_k
+        self._top_p = top_p
         #: Sequences per batched-prefill program (admission waves run
         #: their prompts through ONE program: the dense matmuls — where
         #: the weight streaming is — batch across prompts; the
@@ -411,6 +416,13 @@ class JaxExecutor:
         #: call sites prefer these — the jit wrappers re-trace on first
         #: call, the executables don't).
         self._aot: Dict[str, object] = {}
+        #: Program names whose executable came from the export disk
+        #: cache this start (drives the minimal-smoke fast path).
+        self._from_export_cache: set = set()
+        #: Measured per-decode-step ms (set by warmup) — the engine's
+        #: tier-aware admission cap converts its latency target into a
+        #: step budget with this.
+        self.step_ms: Optional[float] = None
 
     # -- helpers -------------------------------------------------------------
 
@@ -423,6 +435,79 @@ class JaxExecutor:
     def _next_key(self):
         self._key, sub = self._jax.random.split(self._key)
         return sub
+
+    def _export_cache_dir(self) -> Optional[str]:
+        """Directory for serialized post-lowering program artifacts
+        (``jax.export``). LLMQ_EXPORT_CACHE_DIR overrides; otherwise an
+        ``export/`` subdir of the persistent XLA compilation cache when
+        one is configured. Disabled (None) on the mesh path — exported
+        multi-device calling conventions are not worth the risk here.
+
+        Why this exists on top of the XLA cache: XLA *compilation* is
+        fully cached across restarts, but Python tracing + Mosaic
+        kernel LOWERING is not — measured ~27 s per 8B program
+        (docs/performance.md "Warmup anatomy"), making a warm 8B
+        restart ~160 s. ``jax.export`` serializes the post-lowering
+        StableHLO (Mosaic payloads embedded, donation attributes
+        preserved), so a restart deserializes + hits the XLA cache
+        instead of re-lowering."""
+        import os
+
+        if self.mesh is not None and self.mesh.size > 1:
+            return None
+        d = os.environ.get("LLMQ_EXPORT_CACHE_DIR")
+        if d:
+            return d
+        try:
+            import jax
+
+            cache = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            cache = None
+        return os.path.join(cache, "export") if cache else None
+
+    def _export_cache_key(self) -> str:
+        """Geometry + model identity + runtime identity + CODE identity:
+        anything that changes the lowered program must change the key.
+        Code identity hashes the source files the programs trace
+        through (model + ops + this file) — without it, editing
+        forward_decode would silently serve the stale pre-edit
+        computation from the cache."""
+        import hashlib
+        import os
+
+        import jax
+
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src_dirs = [os.path.join(pkg, "models"), os.path.join(pkg, "ops"),
+                    os.path.join(pkg, "ops", "pallas")]
+        src_files = [os.path.abspath(__file__)]
+        for d in src_dirs:
+            if os.path.isdir(d):
+                src_files.extend(
+                    os.path.join(d, f) for f in sorted(os.listdir(d))
+                    if f.endswith(".py"))
+        for path in src_files:
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                pass
+        cfg = self.model_cfg
+        ident = repr((jax.__version__, jax.devices()[0].device_kind,
+                      cfg, self.spec, self.chunk_size, self.prefill_batch,
+                      tuple(self.prefill_buckets), self._top_k,
+                      self._top_p,
+                      jax.tree.map(lambda x: (x.shape, str(x.dtype)),
+                                   self.params),
+                      # Cache tree identity: bf16-KV and int8-KV lower
+                      # different programs — colliding keys would make
+                      # alternating configs evict each other's artifacts.
+                      jax.tree.map(lambda x: (x.shape, str(x.dtype)),
+                                   self.cache)))
+        h.update(ident.encode())
+        return h.hexdigest()[:16]
 
     def _warmup_parallel(self) -> None:
         """AOT-compile every program CONCURRENTLY from abstract shapes
@@ -438,9 +523,14 @@ class JaxExecutor:
         prefer them over the jit wrappers), so each program is traced
         exactly once; with the persistent compilation cache
         (parallel/mesh.enable_compilation_cache) a restart pays only
-        tracing + cache deserialization — seconds, not minutes.
+        tracing + cache deserialization — and with the EXPORT cache
+        (``_export_cache_dir``) not even the tracing + Mosaic lowering:
+        warm restarts deserialize the lowered module per program.
         """
+        import os
+
         import jax
+        from jax import export as jexport
         from concurrent.futures import ThreadPoolExecutor
 
         jnp = self._jnp
@@ -483,8 +573,50 @@ class JaxExecutor:
                           sds((B, MP), i32), sds((B,), f32),
                           sds((B,), i32), sds((B,), jnp.bool_), key)))
 
+        exp_dir = self._export_cache_dir()
+        exp_key = self._export_cache_key() if exp_dir else None
+        if exp_dir:
+            os.makedirs(exp_dir, exist_ok=True)
+
         def compile_one(job):
             name, fn, args = job
+            path = (os.path.join(exp_dir, f"{exp_key}-{name}.jaxexp")
+                    if exp_dir else None)
+            if path and os.path.exists(path):
+                try:
+                    with open(path, "rb") as f:
+                        exported = jexport.deserialize(
+                            bytearray(f.read()))
+                    # Re-jit the deserialized call with the SAME
+                    # donation: the exported module carries the
+                    # aliasing attributes, so the pool stays in-place.
+                    self._aot[name] = jax.jit(
+                        exported.call,
+                        donate_argnums=(1,)).lower(*args).compile()
+                    self._from_export_cache.add(name)
+                    return f"{name} (export cache)"
+                except Exception:  # noqa: BLE001 — cache is best-effort
+                    log.exception(
+                        "export-cache load failed for %s; re-lowering",
+                        name)
+            if path:
+                try:
+                    # One lowering, used for BOTH the executable and the
+                    # serialized artifact: export captures the lowered
+                    # StableHLO (Mosaic payloads + donation included),
+                    # then compiling its .call skips re-lowering.
+                    exported = jexport.export(fn)(*args)
+                    self._aot[name] = jax.jit(
+                        exported.call,
+                        donate_argnums=(1,)).lower(*args).compile()
+                    tmp = f"{path}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(exported.serialize())
+                    os.replace(tmp, path)
+                    return f"{name} (exported)"
+                except Exception:  # noqa: BLE001
+                    log.exception(
+                        "export of %s failed; plain AOT compile", name)
             self._aot[name] = fn.lower(*args).compile()
             return name
 
@@ -496,7 +628,15 @@ class JaxExecutor:
         """Compile the decode step and every prefill bucket up front
         (the reference has no analogue; SURVEY §7 'warmup at startup'):
         parallel AOT compile, then one tiny execution per program as a
-        smoke pass (near-free — the executables already exist)."""
+        smoke pass (near-free — the executables already exist).
+
+        When EVERY program deserialized from the export disk cache, the
+        smoke pass shrinks to the smallest bucket + the decode programs:
+        the artifacts were smoke-tested when first exported (same code
+        identity, enforced by the cache key), and the big-bucket
+        executions are what keeps a warm restart from hitting its <60 s
+        target (a 2048-token prefill execution over a tunneled runtime
+        costs many seconds by itself)."""
         try:
             self._warmup_parallel()
         except Exception:  # noqa: BLE001 — AOT is an optimization; the
@@ -504,9 +644,12 @@ class JaxExecutor:
             log.exception("parallel AOT warmup failed; falling back")
             self._aot.clear()
         spec = self.spec
+        cache_warm = bool(self._aot) and all(
+            name in self._from_export_cache for name in self._aot)
         bt = np.zeros((1, spec.max_pages_per_seq), np.int32)
         prev = 0
-        for b in self.prefill_buckets:
+        for b in (self.prefill_buckets[:1] if cache_warm
+                  else self.prefill_buckets):
             # One full-size prefill per bucket: lengths prev+1..b stream a
             # chunk of exactly size-b through the bucket-b program.
             self.prefill([1] * min(b, prev + 1), 0, bt[0], 0.0, 0)
@@ -520,6 +663,23 @@ class JaxExecutor:
         if self.chunk_size > 1:
             self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
                               np.ones(spec.batch_size, np.int32))
+            # Per-step cost estimate for the engine's tier-aware
+            # admission cap: time a 1-step and a K-step chunk (both pay
+            # one host round-trip, so the difference isolates compute).
+            # Warmup writes land on reserved page 0 only.
+            import time as _time
+            t0 = _time.perf_counter()
+            self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
+                              np.ones(spec.batch_size, np.int32))
+            t1 = _time.perf_counter()
+            self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
+                              np.full(spec.batch_size, self.chunk_size,
+                                      np.int32))
+            t2 = _time.perf_counter()
+            self.step_ms = max(
+                0.05, ((t2 - t1) - (t1 - t0)) / max(1, self.chunk_size - 1)
+                * 1e3)
+            log.info("warmup measured decode step ~%.2f ms", self.step_ms)
 
     # -- Executor API --------------------------------------------------------
 
@@ -636,10 +796,13 @@ class JaxExecutor:
         this chunk runs). Without it, inputs come from host arrays and
         no row starts latched.
 
-        ``overrides`` — (slot, device_scalar) pairs whose input token
-        comes DEVICE-to-device (a just-prefilled sequence's sampled
-        first token joins the batch without ever visiting the host:
-        same-step decode join, one pipeline cycle saved per request).
+        ``overrides`` — (slot, device_scalar, pos) triples whose input
+        token comes DEVICE-to-device (a just-prefilled sequence's
+        sampled first token joins the batch without ever visiting the
+        host: same-step decode join, one pipeline cycle saved per
+        request). The lane's position and done-latch are overridden
+        too, so a join can land on a carry lane whose previous owner
+        finished (its latch must clear for the new sequence).
         """
         jnp = self._jnp
         fn = self._aot.get("decode_chunk", self._decode_chunk)
@@ -649,8 +812,10 @@ class JaxExecutor:
             tok_in = jnp.asarray(tokens, jnp.int32)
             pos_in = jnp.asarray(positions, jnp.int32)
             done_in = jnp.zeros(self.spec.batch_size, bool)
-        for slot, tok_dev in (overrides or ()):
+        for slot, tok_dev, pos in (overrides or ()):
             tok_in = tok_in.at[slot].set(tok_dev.astype(jnp.int32))
+            pos_in = pos_in.at[slot].set(jnp.int32(pos))
+            done_in = done_in.at[slot].set(False)
         with annotate("decode_chunk"):
             out, tok, pos, done, self.cache = fn(
                 self.params, self.cache,

@@ -4,3 +4,4 @@ from llmq_tpu.loadbalancer.load_balancer import (  # noqa: F401
     LoadBalancer,
 )
 from llmq_tpu.loadbalancer.router import EngineRouter  # noqa: F401
+from llmq_tpu.loadbalancer.transport import HttpEngineClient  # noqa: F401

@@ -112,16 +112,20 @@ ProbeFn = Callable[[Endpoint], bool]
 
 
 def default_probe(endpoint: Endpoint, timeout: float = 2.0) -> bool:
-    """TCP-connect probe for http/https/tcp URLs; ``local://`` endpoints
-    consult an attached engine's ``healthy()`` if present in metadata."""
+    """Consults an attached engine/transport's ``healthy()`` when one is
+    present in metadata (in-process engines AND http transports — the
+    transport checks the peer's /health engine state, so a host whose
+    server is up but whose engine died still fails over); otherwise
+    TCP-connect for http/https/tcp URLs, trivially-up for bare
+    ``local://``."""
     url = endpoint.url
+    engine = endpoint.metadata.get("engine")
+    if engine is not None and hasattr(engine, "healthy"):
+        try:
+            return bool(engine.healthy())
+        except Exception:  # noqa: BLE001
+            return False
     if url.startswith("local://") or not url:
-        engine = endpoint.metadata.get("engine")
-        if engine is not None and hasattr(engine, "healthy"):
-            try:
-                return bool(engine.healthy())
-            except Exception:  # noqa: BLE001
-                return False
         return True  # in-process with no engine attached: trivially up
     try:
         parsed = urllib.parse.urlparse(url)

@@ -53,18 +53,47 @@ class EngineRouter:
         self._engines[eid] = engine
         return ep
 
+    def register_remote(self, url: str, *,
+                        endpoint_id: Optional[str] = None,
+                        name: Optional[str] = None, weight: float = 1.0,
+                        max_connections: int = 0,
+                        metadata: Optional[Dict] = None,
+                        timeout: float = 120.0) -> Endpoint:
+        """Expose a peer serve process (its REST API at ``url``) as an
+        endpoint: dispatch goes over the HTTP transport, health over
+        its ``/health`` engine state (transport.HttpEngineClient)."""
+        from llmq_tpu.loadbalancer.transport import HttpEngineClient
+
+        client = HttpEngineClient(url, timeout=timeout)
+        eid = endpoint_id or url
+        md = dict(metadata or {})
+        md["engine"] = client
+        ep = Endpoint(id=eid, name=name or url, url=url, weight=weight,
+                      max_connections=max_connections, metadata=md)
+        self.lb.add_endpoint(ep)
+        self._engines[eid] = client
+        return ep
+
     def process_fn(self, ctx, msg: Message) -> None:
         """Worker seam: route one message to the least-loaded (per
         strategy) healthy engine, with conversation affinity."""
         session = msg.conversation_id or None
         ep = self.lb.get_endpoint(msg, session_id=session)
         engine = ep.metadata.get("engine")
+        if engine is None and ep.url.startswith(("http://", "https://")):
+            # Endpoint registered without a transport (e.g. via the
+            # REST admin route): build one on first use and attach it,
+            # so runtime-registered remote hosts are routable too.
+            from llmq_tpu.loadbalancer.transport import HttpEngineClient
+
+            engine = HttpEngineClient(ep.url)
+            ep.metadata["engine"] = engine
+            self._engines[ep.id] = engine
         if engine is None:
             self.lb.release_endpoint(ep.id, is_error=True)
             raise RuntimeError(
-                f"endpoint {ep.id} has no attached engine "
-                f"(url={ep.url!r}) — remote endpoints need a transport "
-                f"process_fn, not the in-process router")
+                f"endpoint {ep.id} has no attached engine and no "
+                f"transport for url {ep.url!r}")
         t0 = time.perf_counter()
         try:
             engine.process_fn(ctx, msg)

@@ -1,0 +1,106 @@
+"""HTTP transport to engines on other hosts — the second half of
+"LB over multi-host TPU workers" (BASELINE config #5).
+
+The reference's scheduler fabricates per-worker URLs
+(`/root/reference/internal/scheduler/scheduler.go:299-301` invents
+``http://llm-processor-N:8080``) and no code path ever dispatches a
+message to one (SURVEY §3.5). Here the dispatch is real and symmetric
+with the in-process path: an :class:`HttpEngineClient` quacks like an
+``InferenceEngine`` at the two seams the router and health machinery
+use —
+
+- ``process_fn(ctx, msg)``: POST the message to the peer serve
+  process's synchronous inference RPC (``POST /api/v1/generate``,
+  api/server.py) and copy the completion + usage back onto the message,
+  honoring the worker's remaining deadline;
+- ``healthy()``: GET the peer's ``/health`` and require its ENGINE to
+  be running — a peer whose HTTP server is up but whose engine thread
+  died reads unhealthy, advancing the LB state machine to failover
+  (the reference's probe hardcodes ``isHealthy := true``,
+  load_balancer.go:593).
+
+So one gateway process can front any mix of in-process engines
+(``local://``) and remote serve hosts (``http://``) behind the same
+LoadBalancer strategies, session affinity and failover.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from llmq_tpu.core.types import Message
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("transport")
+
+
+class HttpEngineClient:
+    """Remote engine behind a serve process's REST API."""
+
+    def __init__(self, base_url: str, *, timeout: float = 120.0,
+                 probe_timeout: float = 2.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.probe_timeout = probe_timeout
+        self.name = self.base_url
+
+    # -- engine-compatible seams --------------------------------------------
+
+    def healthy(self) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"{self.base_url}/health",
+                    timeout=self.probe_timeout) as resp:
+                if resp.status != 200:
+                    return False
+                data = json.loads(resp.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+        # A serve peer reports its engine thread; "stopped" means the
+        # process is up but cannot generate — unhealthy for routing.
+        return data.get("engine", "running") == "running"
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        """Worker seam: relay one drained message to the peer and fold
+        the completion back into ``msg`` (same contract as
+        ``InferenceEngine.process_fn``)."""
+        timeout: Optional[float] = self.timeout
+        if ctx is not None:
+            rem = ctx.remaining()
+            if rem is not None:
+                if rem <= 0:
+                    raise TimeoutError(
+                        f"message {msg.id} deadline expired before dispatch")
+                timeout = min(self.timeout, rem)
+        payload = msg.to_dict()
+        payload["timeout"] = timeout
+        req = urllib.request.Request(
+            f"{self.base_url}/api/v1/generate",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                data = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read().decode("utf-8")).get("error", "")
+            except Exception:  # noqa: BLE001
+                pass
+            if e.code == 504:
+                raise TimeoutError(
+                    f"remote engine {self.base_url} timed out: {detail}"
+                ) from None
+            raise RuntimeError(
+                f"remote engine {self.base_url} failed "
+                f"({e.code}): {detail}") from None
+        except (urllib.error.URLError, OSError) as e:
+            raise RuntimeError(
+                f"remote engine {self.base_url} unreachable: {e}") from None
+        msg.response = data.get("response", "")
+        usage = data.get("usage")
+        if usage:
+            msg.metadata["usage"] = usage

@@ -31,8 +31,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from llmq_tpu.ops.attention import (dispatch_prefill_attention,
+                                    dispatch_prefill_attention_q8,
                                     paged_decode_step,
-                                    paged_kv_write_prefill)
+                                    paged_decode_step_q8,
+                                    paged_kv_write_prefill,
+                                    paged_kv_write_prefill_q8)
 from llmq_tpu.ops.norms import rms_norm
 from llmq_tpu.ops.quant import (embed_lookup, is_quantized, layer_slice,
                                 linear, tied_head_logits)
@@ -247,11 +250,22 @@ def init_kv_pages(cfg: LlamaConfig, num_pages: int, page_size: int,
     ~0.65 ms per pool per layer call on v5e, which dominated the entire
     r2 decode step. Helpers needing heads unflatten VALUES (gathers),
     never the pool buffer itself.
+
+    ``dtype=jnp.int8``: quantized KV cache — halves pool bytes AND the
+    decode step's KV read traffic (docs/performance.md roofline: the
+    next lever after int8 weights). Adds per-(token, kv-head) bf16
+    scale pools shaped (L, P, H_kv, page_size) — see ops/quant.py for
+    why that layout (sublane-tile fit + transpose-free kernels).
     """
     shape = (cfg.n_layers, num_pages, page_size,
              cfg.n_kv_heads * cfg.head_dim)
     dt = dtype or cfg.dtype
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    cache: KVCache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if jnp.dtype(dt) == jnp.int8:
+        sshape = (cfg.n_layers, num_pages, cfg.n_kv_heads, page_size)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.bfloat16)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.bfloat16)
+    return cache
 
 
 # -- forward ------------------------------------------------------------------
@@ -317,7 +331,10 @@ def forward_prefill(
     # per row. The pure-JAX fallback (general B / CPU) scatters into
     # the threaded pool instead.
     lp = params["layers"]
+    quant_kv = "k_scale" in kv_cache
     k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    if quant_kv:
+        pools = (k_pool, v_pool, kv_cache["k_scale"], kv_cache["v_scale"])
     for l in range(cfg.n_layers):
         hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
         q = linear(hn, layer_slice(lp["wq"], l)).reshape(
@@ -328,24 +345,36 @@ def forward_prefill(
             B, T, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # Write this layer's KV into its slice of the pool.
-        k_pool, v_pool = paged_kv_write_prefill(
-            k_pool, v_pool, k, v, block_tables, positions, lengths,
-            jnp.int32(l), enabled=cfg.pallas,
-            multi_ok=cfg.pallas_batched_prefill)
-        # Attend over the full paged history (covers continuation turns);
-        # causality enforced via absolute positions.
-        attn = dispatch_prefill_attention(q, k_pool, v_pool, block_tables,
-                                          positions, seq_lens, l,
-                                          enabled=cfg.pallas,
-                                          multi_ok=cfg.pallas_batched_prefill)
+        if quant_kv:
+            # int8 pools: quantized write + dequantizing attention
+            # (ops/attention.py int8 section).
+            pools = paged_kv_write_prefill_q8(
+                pools, k, v, block_tables, positions, lengths,
+                jnp.int32(l))
+            attn = dispatch_prefill_attention_q8(
+                q, pools, block_tables, positions, seq_lens, l)
+        else:
+            # Write this layer's KV into its slice of the pool.
+            k_pool, v_pool = paged_kv_write_prefill(
+                k_pool, v_pool, k, v, block_tables, positions, lengths,
+                jnp.int32(l), enabled=cfg.pallas,
+                multi_ok=cfg.pallas_batched_prefill)
+            # Attend over the full paged history (covers continuation
+            # turns); causality enforced via absolute positions.
+            attn = dispatch_prefill_attention(
+                q, k_pool, v_pool, block_tables, positions, seq_lens, l,
+                enabled=cfg.pallas, multi_ok=cfg.pallas_batched_prefill)
         h = h + linear(attn.reshape(B, T, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
                      layer_slice(lp["w_up"], l), layer_slice(lp["w_down"], l))
-    new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return _logits(params, h), {"k": new_k, "v": new_v}
+    if quant_kv:
+        out_cache = {"k": pools[0], "v": pools[1],
+                     "k_scale": pools[2], "v_scale": pools[3]}
+    else:
+        out_cache = {"k": k_pool, "v": v_pool}
+    return _logits(params, h), out_cache
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -386,7 +415,10 @@ def forward_decode(
     # degrades to per-layer full copies) — measured 2-8x slower on v5e.
     # Unrolling costs compile time (once, at warmup) instead.
     lp = params["layers"]
+    quant_kv = "k_scale" in kv_cache
     k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    if quant_kv:
+        pools = (k_pool, v_pool, kv_cache["k_scale"], kv_cache["v_scale"])
     for l in range(cfg.n_layers):
         hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
         q = linear(hn, layer_slice(lp["wq"], l)).reshape(
@@ -400,17 +432,94 @@ def forward_decode(
         v = v[:, 0]
         # Fused write + attention (every live sequence owns its page
         # this step; inactive rows redirect to reserved page 0).
-        attn, k_pool, v_pool = paged_decode_step(
-            q, k, v, k_pool, v_pool, block_tables, seq_lens,
-            page_of, slot_of, jnp.int32(l),
-            enabled=cfg.pallas)                            # (B, H, D)
+        if quant_kv:
+            attn, pools = paged_decode_step_q8(
+                q, k, v, pools, block_tables, seq_lens,
+                page_of, slot_of, jnp.int32(l), enabled=cfg.pallas)
+        else:
+            attn, k_pool, v_pool = paged_decode_step(
+                q, k, v, k_pool, v_pool, block_tables, seq_lens,
+                page_of, slot_of, jnp.int32(l),
+                enabled=cfg.pallas)                        # (B, H, D)
         h = h + linear(attn.reshape(B, -1), layer_slice(lp["wo"], l))
         hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
         h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
                      layer_slice(lp["w_up"], l), layer_slice(lp["w_down"], l))
-    new_k, new_v = k_pool, v_pool
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return _logits(params, h), {"k": new_k, "v": new_v}
+    if quant_kv:
+        out_cache = {"k": pools[0], "v": pools[1],
+                     "k_scale": pools[2], "v_scale": pools[3]}
+    else:
+        out_cache = {"k": k_pool, "v": v_pool}
+    return _logits(params, h), out_cache
+
+
+def _sp_forward_local(params: Params, tokens_local: jnp.ndarray,
+                      cfg: LlamaConfig, axis_name: str) -> jnp.ndarray:
+    """Per-device body of the sequence-parallel long-context forward
+    (runs inside ``shard_map``): this device holds a contiguous
+    sequence chunk; attention is exact over the GLOBAL sequence via the
+    ring rotation (ops/ring_attention.py), everything else is local."""
+    from llmq_tpu.ops.ring_attention import ring_attention
+
+    B, Tl = tokens_local.shape
+    my = lax.axis_index(axis_name)
+    pos = my * Tl + jnp.arange(Tl)                       # global positions
+    positions = jnp.broadcast_to(pos[None, :], (B, Tl))
+    h = embed_lookup(params["embed"], tokens_local, cfg.dtype)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    lp = params["layers"]
+    for l in range(cfg.n_layers):
+        hn = rms_norm(h, lp["attn_norm"][l], cfg.norm_eps)
+        q = linear(hn, layer_slice(lp["wq"], l)).reshape(
+            B, Tl, cfg.n_heads, cfg.head_dim)
+        k = linear(hn, layer_slice(lp["wk"], l)).reshape(
+            B, Tl, cfg.n_kv_heads, cfg.head_dim)
+        v = linear(hn, layer_slice(lp["wv"], l)).reshape(
+            B, Tl, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = ring_attention(q, k, v, axis_name=axis_name, causal=True)
+        h = h + linear(attn.reshape(B, Tl, -1), layer_slice(lp["wo"], l))
+        hn2 = rms_norm(h, lp["mlp_norm"][l], cfg.norm_eps)
+        h = h + _mlp(hn2, layer_slice(lp["w_gate"], l),
+                     layer_slice(lp["w_up"], l),
+                     layer_slice(lp["w_down"], l))
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return _logits(params, h)
+
+
+def forward_prefill_sp(params: Params, cfg: LlamaConfig,
+                       tokens: jnp.ndarray, mesh,
+                       axis_name: str = "sp") -> jnp.ndarray:
+    """Long-context prefill/scoring over a sequence-parallel mesh axis.
+
+    The sequence dim of ``tokens`` (B, T) is sharded over ``axis_name``
+    (T must divide by the axis size); each device computes its chunk's
+    full transformer stack locally and exact global causal attention
+    via ring rotation over ICI — peak activation memory O(T/n) per
+    device, which is how a context longer than one chip's HBM prefills
+    at all. Returns (B, T, V) f32 logits sharded the same way.
+
+    Status: model-level long-context path (tested equivalent to the
+    dense ``forward_prefill``); the serving executor does not yet route
+    oversized prompts here — see docs/architecture.md "Long context".
+    No reference counterpart (SURVEY §5: long-context absent there).
+    """
+    from functools import partial as _partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec_t = P(None, axis_name)
+    fn = jax.jit(jax.shard_map(
+        _partial(_sp_forward_local, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), spec_t),
+        out_specs=P(None, axis_name, None),
+        check_vma=False,
+    ))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, spec_t))
+    return fn(params, tokens)
 
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,

@@ -453,3 +453,133 @@ def blockwise_prefill_attention(
         body, (m0, l0, acc0), (jnp.arange(n_blocks), k_c, v_c))
     out = acc / jnp.maximum(l, 1e-30)
     return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+# -- int8 KV cache paths -------------------------------------------------------
+#
+# Pool layout: data pools stay FLAT (L, P, page_size, H_kv·D) in int8;
+# scale pools are (L, P, H_kv, page_size) bf16 (ops/quant.py rationale:
+# H_kv = 8 fills the minimum sublane tile, and (head, position) is the
+# logits layout, so kernels consume scales transpose-free). These
+# functions mirror the bf16 paths one-for-one; ``pools`` is the 4-tuple
+# (k_pool, v_pool, k_scale, v_scale).
+
+
+def _scale_scatter(scale_pool, layer, page_of, slot_of, scales):
+    """Write per-(row, head) scales (N, H_kv) at [layer, page_of[n], :,
+    slot_of[n]]."""
+    Hkv = scale_pool.shape[2]
+    heads = jnp.arange(Hkv)
+    return scale_pool.at[
+        layer, page_of[:, None], heads[None, :], slot_of[:, None]
+    ].set(scales.astype(scale_pool.dtype))
+
+
+def _dequant_window(k_pool, scale_pool, layer, block_tables, D):
+    """Gather + dequantize one layer's pages for a batch of block
+    tables: returns (B, S, H_kv, D) bf16."""
+    B, n_pages = block_tables.shape
+    page_size = k_pool.shape[2]
+    Hkv = k_pool.shape[3] // D
+    S = n_pages * page_size
+    qv = k_pool[layer, block_tables].reshape(
+        B, n_pages, page_size, Hkv, D)
+    sc = scale_pool[layer, block_tables]          # (B, n_pages, Hkv, ps)
+    sc = jnp.moveaxis(sc, 2, 3)                   # (B, n_pages, ps, Hkv)
+    x = qv.astype(jnp.float32) * sc.astype(jnp.float32)[..., None]
+    return x.reshape(B, S, Hkv, D).astype(jnp.bfloat16)
+
+
+def paged_decode_step_q8(q, k_new, v_new, pools, block_tables, seq_lens,
+                         page_of, slot_of, layer, *, enabled: bool = True):
+    """One decode layer against the int8 KV pools: quantize the current
+    token's K/V per (row, head), write rows + scales, attend over the
+    dequantized paged history. Returns (attn, pools).
+
+    TPU path: the int8 fused kernel (fused_decode.py) — same
+    write+attend fusion as bf16, half the page DMA bytes. Fallback:
+    scatter + gather-dequant + the shared GQA attention.
+    """
+    from llmq_tpu.ops.quant import quantize_kv_rows
+
+    k_pool, v_pool, ks_pool, vs_pool = pools
+    B, H, D = q.shape
+    kq, kscale = quantize_kv_rows(k_new)    # (B, Hkv, D) i8, (B, Hkv)
+    vq, vscale = quantize_kv_rows(v_new)
+
+    from llmq_tpu.ops.pallas.fused_decode import fused_kernel_viable
+    fused_ok = (k_pool.shape[2] % 8 == 0
+                and k_pool.shape[3] // D == ks_pool.shape[2] == 8
+                and fused_kernel_viable(
+                    B, k_pool.shape[2], block_tables.shape[1],
+                    k_pool.shape[3], k_pool.dtype.itemsize))
+    use_kernel, interpret = _kernel_route(k_pool, extra_ok=fused_ok,
+                                          enabled=enabled)
+    if use_kernel:
+        attn, pools = _jit_fused_decode_q8()(
+            q, kq, kscale, vq, vscale, pools, block_tables, seq_lens,
+            page_of, layer, interpret=interpret)
+        return attn, pools
+
+    k_pool = k_pool.at[layer, page_of, slot_of].set(kq.reshape(B, -1))
+    v_pool = v_pool.at[layer, page_of, slot_of].set(vq.reshape(B, -1))
+    ks_pool = _scale_scatter(ks_pool, layer, page_of, slot_of, kscale)
+    vs_pool = _scale_scatter(vs_pool, layer, page_of, slot_of, vscale)
+    k = _dequant_window(k_pool, ks_pool, layer, block_tables, D)
+    v = _dequant_window(v_pool, vs_pool, layer, block_tables, D)
+    attn = _gqa_attend(q, k, v, seq_lens)
+    return attn, (k_pool, v_pool, ks_pool, vs_pool)
+
+
+def _jit_fused_decode_q8():
+    def make():
+        from llmq_tpu.ops.pallas.fused_decode import (
+            fused_decode_attention_q8_pallas)
+        return jax.jit(fused_decode_attention_q8_pallas,
+                       static_argnames=("pages_per_chunk", "interpret"))
+    return _kernel_jit("fused_decode_q8", make)
+
+
+def paged_kv_write_prefill_q8(pools, k, v, block_tables, positions,
+                              lengths, layer):
+    """Prefill-chunk write into the int8 pools: quantize every (token,
+    head) row and scatter rows + scales (pure-JAX scatter — prefill is
+    compute-bound, and the scatter runs once per admission chunk, not
+    per decode step). k/v: (B, T, H_kv, D)."""
+    from llmq_tpu.ops.quant import quantize_kv_rows
+
+    k_pool, v_pool, ks_pool, vs_pool = pools
+    B, T = k.shape[0], k.shape[1]
+    page_size = k_pool.shape[2]
+    GD = k_pool.shape[3]
+    kq, kscale = quantize_kv_rows(k)       # (B, T, Hkv, D), (B, T, Hkv)
+    vq, vscale = quantize_kv_rows(v)
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])     # (B, T)
+    flat_valid = valid.reshape(-1)
+    flat_pos = positions.reshape(-1)
+    page_of = jnp.where(
+        flat_valid,
+        block_tables[jnp.repeat(jnp.arange(B), T), flat_pos // page_size],
+        0)
+    slot_of = jnp.where(flat_valid, flat_pos % page_size, 0)
+    k_pool = k_pool.at[layer, page_of, slot_of].set(kq.reshape(-1, GD))
+    v_pool = v_pool.at[layer, page_of, slot_of].set(vq.reshape(-1, GD))
+    ks_pool = _scale_scatter(ks_pool, layer, page_of, slot_of,
+                             kscale.reshape(B * T, -1))
+    vs_pool = _scale_scatter(vs_pool, layer, page_of, slot_of,
+                             vscale.reshape(B * T, -1))
+    return k_pool, v_pool, ks_pool, vs_pool
+
+
+def dispatch_prefill_attention_q8(q, pools, block_tables, positions,
+                                  seq_lens, layer) -> jnp.ndarray:
+    """Prefill-chunk attention over the int8 pools: gather + dequantize
+    the window, then the blockwise online-softmax (the gather between
+    scatter writes is the pure path's known cost; the decode hot loop is
+    where the kernel lives)."""
+    k_pool, v_pool, ks_pool, vs_pool = pools
+    D = q.shape[3]
+    k_hist = _dequant_window(k_pool, ks_pool, layer, block_tables, D)
+    v_hist = _dequant_window(v_pool, vs_pool, layer, block_tables, D)
+    return blockwise_prefill_attention(q, k_hist, v_hist, positions,
+                                       seq_lens)

@@ -476,3 +476,403 @@ def fused_decode_attention_pallas(
       jnp.asarray(layer, jnp.int32).reshape(1),
       q, kn, vn, bias, k_pool, v_pool)
     return out.astype(q.dtype), (k_out, v_out)
+
+
+# -- int8 KV variant -----------------------------------------------------------
+#
+# Same structure as _fused_kernel with three deltas:
+# 1. pool pages are int8 (HALF the fetch/writeback DMA bytes — decode is
+#    bandwidth-bound, so this is the point);
+# 2. per-(token, kv-head) bf16 scale pools (L, P, H_kv, page_size) ride
+#    along: scale pages are fetched/merged/written back next to their
+#    data pages on separate semaphores (DMA semaphore sharers must copy
+#    identical byte counts; scale pages are 2·H_kv·ps bytes vs GD·ps);
+# 3. dequantization happens in-register at the matmuls: K scales
+#    multiply LOGITS groupwise (the (head, position) scale layout IS the
+#    logits layout — no transpose), V scales fold into the probabilities
+#    before the PV matmul.
+
+
+def _fused_kernel_q8(
+    # scalar prefetch (SMEM)
+    block_tables_ref, seq_lens_ref, write_page_ref, layer_ref,
+    # inputs
+    q_ref,              # (R, H, D) VMEM bf16
+    k_new_ref,          # (R, GD) VMEM int8 — pre-quantized current rows
+    v_new_ref,          # (R, GD) VMEM int8
+    kns_ref,            # (R, Hkv, ps) bf16 — new K scales, pre-broadcast
+    vns_ref,            # (R, Hkv, ps) bf16
+    bias_ref,           # (R, 1, 8, S) bf16
+    k_hbm, v_hbm,       # (L, P, ps, GD) int8 ANY — aliased
+    ks_hbm, vs_hbm,     # (L, P, Hkv, ps) bf16 ANY — aliased
+    # outputs
+    out_ref,            # (R, H, D)
+    k_out, v_out, ks_out, vs_out,
+    # scratch
+    m_ref, l_ref, acc_ref, qbd_ref,
+    k_scratch, v_scratch,           # (2, R, ppc, ps, GD) int8
+    ks_scratch, vs_scratch,         # (2, R, ppc, Hkv, ps) bf16
+    state, sem, ssem, wsem, swsem,
+    *,
+    rows_per_tile: int,
+    pages_per_chunk: int,
+    page_size: int,
+    num_chunks: int,
+    batch: int,
+    n_rep: int,
+    scale: float,
+):
+    t = pl.program_id(0)
+    c = pl.program_id(1)
+    R = rows_per_tile
+    ppc = pages_per_chunk
+    chunk_tokens = ppc * page_size
+    num_tiles = pl.num_programs(0)
+    lyr = layer_ref[0]
+
+    def row_c_last(row):
+        eff = jnp.maximum(seq_lens_ref[row], 1)
+        return (eff - 1) // chunk_tokens
+
+    def tile_c_last(tile):
+        m = row_c_last(tile * R)
+        for r in range(1, R):
+            m = jnp.maximum(m, row_c_last(tile * R + r))
+        return m
+
+    def start_fetch(tile, chunk, slot):
+        base = chunk * ppc
+        for r in range(R):
+            row = tile * R + r
+            eff = jnp.maximum(seq_lens_ref[row], 1)
+            for j in range(ppc):
+                live = (base + j) * page_size < eff
+
+                @pl.when(live)
+                def _():
+                    pid = block_tables_ref[row, base + j]
+                    pltpu.make_async_copy(
+                        k_out.at[lyr, pid], k_scratch.at[slot, r, j],
+                        sem.at[0, slot]).start()
+                    pltpu.make_async_copy(
+                        v_out.at[lyr, pid], v_scratch.at[slot, r, j],
+                        sem.at[1, slot]).start()
+                    pltpu.make_async_copy(
+                        ks_out.at[lyr, pid], ks_scratch.at[slot, r, j],
+                        ssem.at[0, slot]).start()
+                    pltpu.make_async_copy(
+                        vs_out.at[lyr, pid], vs_scratch.at[slot, r, j],
+                        ssem.at[1, slot]).start()
+
+    def wait_fetch(tile, chunk, slot):
+        base = chunk * ppc
+        for r in range(R):
+            row = tile * R + r
+            eff = jnp.maximum(seq_lens_ref[row], 1)
+            for j in range(ppc):
+                live = (base + j) * page_size < eff
+
+                @pl.when(live)
+                def _():
+                    pid = block_tables_ref[row, base + j]
+                    pltpu.make_async_copy(
+                        k_out.at[lyr, pid], k_scratch.at[slot, r, j],
+                        sem.at[0, slot]).wait()
+                    pltpu.make_async_copy(
+                        v_out.at[lyr, pid], v_scratch.at[slot, r, j],
+                        sem.at[1, slot]).wait()
+                    pltpu.make_async_copy(
+                        ks_out.at[lyr, pid], ks_scratch.at[slot, r, j],
+                        ssem.at[0, slot]).wait()
+                    pltpu.make_async_copy(
+                        vs_out.at[lyr, pid], vs_scratch.at[slot, r, j],
+                        ssem.at[1, slot]).wait()
+
+    @pl.when(jnp.logical_and(t == 0, c == 0))
+    def _():
+        state[_CONSUMED] = 0
+        k_scratch[...] = jnp.zeros_like(k_scratch)
+        v_scratch[...] = jnp.zeros_like(v_scratch)
+        # Scale scratch must be FINITE too: dead positions contribute
+        # k_stale·scale_stale through the masked softmax; a NaN scale
+        # would ride straight through the additive mask.
+        ks_scratch[...] = jnp.zeros_like(ks_scratch)
+        vs_scratch[...] = jnp.zeros_like(vs_scratch)
+        start_fetch(0, 0, 0)
+
+    @pl.when(c == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, -1e29)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        qbd_ref[...] = jnp.zeros_like(qbd_ref)
+        D = q_ref.shape[2]
+        Hkv = q_ref.shape[1] // n_rep
+        for g in range(Hkv):
+            qbd_ref[:, g * n_rep:(g + 1) * n_rep, g * D:(g + 1) * D] = (
+                q_ref[:, g * n_rep:(g + 1) * n_rep, :])
+
+    c_last = tile_c_last(t)
+    fetched = c <= c_last
+
+    @pl.when(fetched)
+    def _():
+        consumed = state[_CONSUMED]
+        slot = jax.lax.rem(consumed, 2)
+        nslot = 1 - slot
+
+        @pl.when(c < c_last)
+        def _():
+            start_fetch(t, c + 1, nslot)
+
+        @pl.when(jnp.logical_and(c == c_last, t + 1 < num_tiles))
+        def _():
+            start_fetch(t + 1, 0, nslot)
+
+        wait_fetch(t, c, slot)
+
+        kn_all = k_new_ref[...]                          # (R, GD) int8
+        vn_all = v_new_ref[...]
+        for r in range(R):
+            row = t * R + r
+            cur = seq_lens_ref[row] - 1
+            cur_page_j = cur // page_size
+            cur_chunk = cur_page_j // ppc
+            jj = cur_page_j - cur_chunk * ppc
+            s = cur - cur_page_j * page_size
+            do_merge = c == cur_chunk
+            tile_lo = (s // 8) * 8
+            for j in range(ppc):
+                @pl.when(jnp.logical_and(do_merge, j == jj))
+                def _():
+                    sl = jax.lax.broadcasted_iota(
+                        jnp.int32, (page_size, 1), 0)
+                    keep = sl != s
+                    k_scratch[slot, r, j] = jnp.where(
+                        keep, k_scratch[slot, r, j],
+                        kn_all[r:r + 1].astype(k_scratch.dtype))
+                    v_scratch[slot, r, j] = jnp.where(
+                        keep, v_scratch[slot, r, j],
+                        vn_all[r:r + 1].astype(v_scratch.dtype))
+                    # Scale column s ← this row's per-head scales (the
+                    # input arrives pre-broadcast along ps, so the
+                    # merge is one lane-select).
+                    li = jax.lax.broadcasted_iota(
+                        jnp.int32, (ks_scratch.shape[3], page_size), 1)
+                    skeep = li != s
+                    ks_scratch[slot, r, j] = jnp.where(
+                        skeep, ks_scratch[slot, r, j], kns_ref[r])
+                    vs_scratch[slot, r, j] = jnp.where(
+                        skeep, vs_scratch[slot, r, j], vns_ref[r])
+                    wp = write_page_ref[row]
+                    pltpu.make_async_copy(
+                        k_scratch.at[slot, r, j, pl.ds(tile_lo, 8)],
+                        k_out.at[lyr, wp, pl.ds(tile_lo, 8)],
+                        wsem.at[0, r]).start()
+                    pltpu.make_async_copy(
+                        v_scratch.at[slot, r, j, pl.ds(tile_lo, 8)],
+                        v_out.at[lyr, wp, pl.ds(tile_lo, 8)],
+                        wsem.at[1, r]).start()
+                    # Scale pages are tiny (Hkv·ps bf16): write whole.
+                    pltpu.make_async_copy(
+                        ks_scratch.at[slot, r, j],
+                        ks_out.at[lyr, wp], swsem.at[0, r]).start()
+                    pltpu.make_async_copy(
+                        vs_scratch.at[slot, r, j],
+                        vs_out.at[lyr, wp], swsem.at[1, r]).start()
+
+        S = chunk_tokens
+        GD = acc_ref.shape[2]
+        Hkv = ks_scratch.shape[3]
+        H = acc_ref.shape[1]
+        q = qbd_ref[...]                                # (R, H, GD)
+        k = k_scratch[slot].reshape(R, S, GD).astype(jnp.bfloat16)
+        v = v_scratch[slot].reshape(R, S, GD).astype(jnp.bfloat16)
+        dims = (((2,), (2,)), ((0,), (0,)))
+        logits = jax.lax.dot_general(
+            q, k, dims,
+            preferred_element_type=jnp.float32) * scale   # (R, H, S)
+
+        def head_scales(s_scratch):
+            """(2, R, ppc, Hkv, ps) scratch → (R, H, S) f32 multiplier:
+            pages lane-concatenated into the chunk's S axis, groups
+            expanded to their n_rep query heads (g-major head order —
+            matches the block-diagonal q layout)."""
+            pages = [s_scratch[slot, :, j] for j in range(ppc)]
+            hs = (pages[0] if ppc == 1
+                  else jnp.concatenate(pages, axis=2))     # (R, Hkv, S)
+            rows = []
+            for g in range(Hkv):
+                rows.extend([hs[:, g:g + 1, :]] * n_rep)
+            return jnp.concatenate(rows, axis=1).astype(jnp.float32)
+
+        # Dequantize K: the (head, position) scale layout IS the logits
+        # layout — one elementwise multiply, no transpose.
+        logits = logits * head_scales(ks_scratch)
+        bias = bias_ref[...].reshape(R, 8, S)[:, :1, :]
+        logits = logits + jnp.broadcast_to(
+            bias.astype(jnp.float32), (R, H, S))
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        # Dequantize V by folding its scales into the probabilities
+        # BEFORE the PV matmul: out = Σ_s (p·vscale)[s] · v_int8[s].
+        p = p * head_scales(vs_scratch)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (R, H, GD)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+        for r in range(R):
+            row = t * R + r
+            cur = seq_lens_ref[row] - 1
+            cur_chunk = (cur // page_size) // ppc
+
+            @pl.when(c == cur_chunk)
+            def _():
+                wp = write_page_ref[row]
+                pltpu.make_async_copy(
+                    k_scratch.at[slot, r, 0, pl.ds(0, 8)],
+                    k_out.at[lyr, wp, pl.ds(0, 8)],
+                    wsem.at[0, r]).wait()
+                pltpu.make_async_copy(
+                    v_scratch.at[slot, r, 0, pl.ds(0, 8)],
+                    v_out.at[lyr, wp, pl.ds(0, 8)],
+                    wsem.at[1, r]).wait()
+                pltpu.make_async_copy(
+                    ks_scratch.at[slot, r, 0],
+                    ks_out.at[lyr, wp], swsem.at[0, r]).wait()
+                pltpu.make_async_copy(
+                    vs_scratch.at[slot, r, 0],
+                    vs_out.at[lyr, wp], swsem.at[1, r]).wait()
+
+        state[_CONSUMED] = consumed + 1
+
+    @pl.when(c == num_chunks - 1)
+    def _():
+        res = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)  # (R,H,GD)
+        D = out_ref.shape[2]
+        Hkv = out_ref.shape[1] // n_rep
+        for g in range(Hkv):
+            out_ref[:, g * n_rep:(g + 1) * n_rep, :] = res[
+                :, g * n_rep:(g + 1) * n_rep,
+                g * D:(g + 1) * D].astype(out_ref.dtype)
+
+
+def fused_decode_attention_q8_pallas(
+    q: jnp.ndarray,             # (B, H, D) bf16
+    k_new_q: jnp.ndarray,       # (B, H_kv, D) int8 — pre-quantized
+    k_new_scale: jnp.ndarray,   # (B, H_kv) bf16
+    v_new_q: jnp.ndarray,
+    v_new_scale: jnp.ndarray,
+    pools,                      # (k, v, k_scale, v_scale) — k/v int8
+    block_tables: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    write_page: jnp.ndarray,
+    layer: jnp.ndarray | int = 0,
+    *,
+    pages_per_chunk: int = 0,
+    interpret: bool = False,
+):
+    """int8-KV fused decode step (see _fused_kernel_q8). Returns
+    (attn (B, H, D), pools)."""
+    k_pool, v_pool, ks_pool, vs_pool = pools
+    B, H, D = q.shape
+    L, P, page_size, GD = k_pool.shape
+    Hkv = GD // D
+    max_pages = block_tables.shape[1]
+    n_rep = H // Hkv
+    if GD % 128:
+        raise ValueError(f"H_kv*D = {GD} must be a multiple of 128")
+    plan = _tile_plan(B, page_size, max_pages, GD, k_pool.dtype.itemsize,
+                      pages_per_chunk)
+    if plan is None:
+        raise ValueError(
+            f"no legal q8 fused tile plan for B={B} "
+            f"page_size={page_size} GD={GD}")
+    R, ppc = plan
+    num_tiles = B // R
+    num_chunks = max_pages // ppc
+
+    S = ppc * page_size
+    pos_all = (jnp.arange(num_chunks * S, dtype=jnp.int32)
+               .reshape(1, num_chunks, 1, S))
+    bias = jnp.where(pos_all < seq_lens.reshape(B, 1, 1, 1),
+                     0.0, NEG_INF).astype(jnp.bfloat16)
+    bias = jnp.broadcast_to(bias, (B, num_chunks, 8, S))
+    kn = k_new_q.reshape(B, GD)
+    vn = v_new_q.reshape(B, GD)
+    # Scales pre-broadcast along the page dim: the kernel's merge is
+    # then a single lane-select against the fetched scale page.
+    kns = jnp.broadcast_to(
+        k_new_scale.astype(jnp.bfloat16)[:, :, None], (B, Hkv, page_size))
+    vns = jnp.broadcast_to(
+        v_new_scale.astype(jnp.bfloat16)[:, :, None], (B, Hkv, page_size))
+
+    kernel = functools.partial(
+        _fused_kernel_q8, rows_per_tile=R, pages_per_chunk=ppc,
+        page_size=page_size, num_chunks=num_chunks, batch=B,
+        n_rep=n_rep, scale=D ** -0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(num_tiles, num_chunks),
+        in_specs=[
+            pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
+            pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
+            pl.BlockSpec((R, Hkv, page_size), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, Hkv, page_size), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec((R, 1, 8, S), lambda t, c, *_: (t, c, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, H, 1), jnp.float32),
+            pltpu.VMEM((R, H, 1), jnp.float32),
+            pltpu.VMEM((R, H, GD), jnp.float32),
+            pltpu.VMEM((R, H, GD), q.dtype),
+            pltpu.VMEM((2, R, ppc, page_size, GD), k_pool.dtype),
+            pltpu.VMEM((2, R, ppc, page_size, GD), v_pool.dtype),
+            pltpu.VMEM((2, R, ppc, Hkv, page_size), ks_pool.dtype),
+            pltpu.VMEM((2, R, ppc, Hkv, page_size), vs_pool.dtype),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, R)),
+            pltpu.SemaphoreType.DMA((2, R)),
+        ],
+    )
+    # Operand order: 4 scalar-prefetch, q, kn, vn, kns, vns, bias, then
+    # the four pools at operands 10-13 aliased to outputs 1-4.
+    out, k_out, v_out, ks_out, vs_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, H, D), q.dtype),
+                   jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+                   jax.ShapeDtypeStruct(ks_pool.shape, ks_pool.dtype),
+                   jax.ShapeDtypeStruct(vs_pool.shape, vs_pool.dtype)],
+        input_output_aliases={10: 1, 11: 2, 12: 3, 13: 4},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      write_page.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
+      q, kn, vn, kns, vns, bias, k_pool, v_pool, ks_pool, vs_pool)
+    return out.astype(q.dtype), (k_out, v_out, ks_out, vs_out)

@@ -188,3 +188,34 @@ def params_bytes(params: Params) -> int:
     """On-device byte footprint of a (possibly quantized) param tree."""
     return sum(x.size * x.dtype.itemsize
                for x in jax.tree_util.tree_leaves(params))
+
+
+# -- int8 KV cache ------------------------------------------------------------
+#
+# Per-token-per-KV-head symmetric int8 (docs/performance.md "int8 KV
+# cache is the next lever"): KV reads are ~2 GB of an 8B decode step's
+# ~10 GB HBM floor, and the POOL's byte size also bounds how many
+# sequences fit resident. Scales are bf16, one per (token, kv-head),
+# stored in pools shaped (L, P, H_kv, page_size): for the llama3 family
+# H_kv = 8 exactly fills the TPU's minimum sublane tile, so a page's
+# scales are one aligned (8, page_size) block — and the (head, position)
+# layout is ALSO the logits layout, so kernels apply K scales to logits
+# and V scales to probabilities without any transpose.
+
+
+def quantize_kv_rows(x: jnp.ndarray):
+    """Quantize KV rows (..., H_kv, D) → (int8 (..., H_kv, D),
+    bf16 scales (..., H_kv)). Symmetric max-abs per (row, head)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv_rows`: q (..., H_kv, D) int8 ×
+    scales (..., H_kv) → (..., H_kv, D) ``dtype``."""
+    return (q.astype(jnp.float32)
+            * scale.astype(jnp.float32)[..., None]).astype(dtype)

@@ -93,14 +93,25 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh,
     return out
 
 
-def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict[str, NamedSharding]:
+def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh,
+                       quantized: bool = False) -> Dict[str, NamedSharding]:
     """(L, P, page_size, H_kv·head_dim) — shard the flat KV-head·dim axis
     on tp. Contiguous chunks of the flat axis are whole KV heads (the
     flat axis is H_kv-major), so partitioning it by tp when tp divides
-    H_kv is exactly the KV-head sharding of the 5-D layout."""
+    H_kv is exactly the KV-head sharding of the 5-D layout.
+
+    ``quantized``: the int8 cache adds (L, P, H_kv, page_size) scale
+    pools — same head partitioning, KV-head axis at dim 2. The returned
+    tree must match the cache tree exactly (jax zips them), so scale
+    entries exist only when the cache has them."""
     tp_kv = _axis(mesh, "tp", cfg.n_kv_heads)
     ns = NamedSharding(mesh, P(None, None, None, tp_kv))
-    return {"k": ns, "v": ns}
+    out = {"k": ns, "v": ns}
+    if quantized:
+        s_ns = NamedSharding(mesh, P(None, None, tp_kv, None))
+        out["k_scale"] = s_ns
+        out["v_scale"] = s_ns
+    return out
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:

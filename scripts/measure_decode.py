@@ -41,9 +41,13 @@ print(f"init {time.perf_counter()-t0:.1f}s, {param_count(params)/1e9:.2f}B leave
 page_size = page_size_arg
 pages_per_seq = max_seq // page_size
 num_pages = batch * pages_per_seq + 1
+kv_quant = os.environ.get("LLMQ_KV_QUANT", "") == "int8"
+import jax.numpy as jnp
 ex = JaxExecutor(cfg, params, batch_size=batch, page_size=page_size,
                  num_pages=num_pages, chunk_size=chunk,
-                 prefill_buckets=[128, 512], eos_id=-1)
+                 prefill_buckets=[128, 512], eos_id=-1,
+                 cache_dtype=(jnp.int8 if kv_quant else None))
+print(f"kv cache: {'int8' if kv_quant else 'model dtype'}", flush=True)
 t0 = time.perf_counter()
 ex.warmup()
 print(f"warmup {time.perf_counter()-t0:.1f}s", flush=True)

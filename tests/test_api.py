@@ -27,6 +27,7 @@ from llmq_tpu.scheduling.resource_scheduler import ResourceScheduler
 
 class Client:
     def __init__(self, port: int) -> None:
+        self.port = port
         self.base = f"http://127.0.0.1:{port}"
 
     def request(self, method: str, path: str, body=None, headers=None):
@@ -408,3 +409,81 @@ class TestAdmin:
         client, _ = stack
         status, _, _ = client.post("/api/v1/admin/dead-letter/requeue/ghost")
         assert status == 404
+
+
+class TestStreamingAndGenerate:
+    def _sse(self, port, body):
+        """POST and parse a text/event-stream response into events."""
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/api/v1/messages", json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        events = []
+        name, data = "message", []
+        for raw in resp:
+            line = raw.decode("utf-8").rstrip("\n")
+            if line.startswith("event: "):
+                name = line[len("event: "):]
+            elif line.startswith("data: "):
+                data.append(line[len("data: "):])
+            elif not line and data:
+                events.append((name, json.loads("\n".join(data))))
+                name, data = "message", []
+        conn.close()
+        return events
+
+    def test_stream_tokens_sse(self, stack):
+        client, server = stack
+        events = self._sse(client.port, {
+            "content": "stream me please", "user_id": "u",
+            "stream": True})
+        kinds = [k for k, _ in events]
+        assert kinds[0] == "start"
+        assert kinds[-1] == "done"
+        mid = events[0][1]["message_id"]
+        tokens = "".join(d["token"] for k, d in events if k == "message")
+        done = events[-1][1]
+        assert tokens == "stream me please"      # echo engine
+        assert done["finish_reason"] == "eos"
+        assert done["usage"]["completion_tokens"] > 0
+        assert done["first_token_ms"] is not None
+        # The streamed message is visible to the query API afterwards.
+        status, body, _ = client.get(f"/api/v1/messages/{mid}")
+        assert status == 200
+        assert body["status"] == "completed"
+        assert body["response"] == "stream me please"
+
+    def test_stream_without_engine_503(self, stack):
+        client, server = stack
+        engine, server.engine = server.engine, None
+        try:
+            status, body, _ = client.post(
+                "/api/v1/messages",
+                {"content": "x", "user_id": "u", "stream": True})
+            assert status == 503
+        finally:
+            server.engine = engine
+
+    def test_generate_sync_rpc(self, stack):
+        client, _ = stack
+        status, body, _ = client.post(
+            "/api/v1/generate",
+            {"id": "rpc1", "content": "remote dispatch",
+             "user_id": "u"})
+        assert status == 200
+        assert body["response"] == "remote dispatch"
+        assert body["usage"]["completion_tokens"] > 0
+
+    def test_stream_multibyte_utf8_across_bursts(self, stack):
+        """A multi-byte UTF-8 char split across token commits must not
+        stream as U+FFFD: the delta logic holds back incomplete tails
+        (ByteTokenizer = one byte per token, so 'héllo' always splits)."""
+        client, server = stack
+        events = self._sse(client.port, {
+            "content": "héllo wörld ✓", "user_id": "u", "stream": True})
+        tokens = "".join(d["token"] for k, d in events if k == "message")
+        assert tokens == "héllo wörld ✓"
+        assert "�" not in tokens

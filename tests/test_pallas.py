@@ -445,3 +445,63 @@ class TestBlockwisePrefill:
                                           block_size=4)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-2, rtol=2e-2)
+
+
+class TestFusedDecodeQ8:
+    def _mk(self, rng, B=8, L=2, P=33, ps=8, Hkv=8, D=16, H=16, mp=4):
+        from llmq_tpu.ops.quant import quantize_kv_rows
+        GD = Hkv * D
+        k_pool = jnp.zeros((L, P, ps, GD), jnp.int8)
+        v_pool = jnp.zeros((L, P, ps, GD), jnp.int8)
+        ks = jnp.zeros((L, P, Hkv, ps), jnp.bfloat16)
+        vs = jnp.zeros((L, P, Hkv, ps), jnp.bfloat16)
+        # Pre-populate history through the PURE write path so both
+        # implementations read identical quantized pools.
+        hist_k = jnp.asarray(rng.standard_normal((B, mp * ps, Hkv, D)),
+                             jnp.float32)
+        hist_v = jnp.asarray(rng.standard_normal((B, mp * ps, Hkv, D)),
+                             jnp.float32)
+        bt = jnp.asarray(
+            rng.permutation(np.arange(1, P))[:B * mp].reshape(B, mp),
+            jnp.int32)
+        return (k_pool, v_pool, ks, vs), hist_k, hist_v, bt
+
+    def test_matches_pure_q8(self, monkeypatch):
+        from llmq_tpu.ops.attention import paged_decode_step_q8
+        from llmq_tpu.ops.pallas.fused_decode import (
+            fused_decode_attention_q8_pallas)
+        from llmq_tpu.ops.quant import quantize_kv_rows
+
+        rng = np.random.default_rng(7)
+        B, Hkv, D, H, ps, mp = 8, 8, 16, 16, 8, 4
+        pools, hist_k, hist_v, bt = self._mk(rng)
+        # Write two history tokens per row via the pure path.
+        monkeypatch.setenv("LLMQ_PALLAS", "0")
+        positions = jnp.asarray([0, 3, 7, 8, 15, 20, 25, 29], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+        for step in range(2):
+            pos = positions + step
+            page_of = bt[jnp.arange(B), pos // ps]
+            slot_of = pos % ps
+            _, pools = paged_decode_step_q8(
+                q, hist_k[:, step], hist_v[:, step], pools, bt, pos + 1,
+                page_of, slot_of, 1)
+        # Step 3: pure vs kernel from the SAME pool state.
+        pos = positions + 2
+        seq_lens = pos + 1
+        page_of = bt[jnp.arange(B), pos // ps]
+        slot_of = pos % ps
+        kn, vn = hist_k[:, 2], hist_v[:, 2]
+        ref_attn, ref_pools = paged_decode_step_q8(
+            q, kn, vn, pools, bt, seq_lens, page_of, slot_of, 1)
+        kq, ksc = quantize_kv_rows(kn)
+        vq, vsc = quantize_kv_rows(vn)
+        attn, out_pools = fused_decode_attention_q8_pallas(
+            q, kq, ksc, vq, vsc, pools, bt, seq_lens, page_of, 1,
+            pages_per_chunk=2, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(attn, np.float32), np.asarray(ref_attn, np.float32),
+            atol=3e-2, rtol=3e-2)
+        for a, b in zip(out_pools, ref_pools):
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32))

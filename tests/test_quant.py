@@ -207,3 +207,100 @@ class TestSizing:
         kv_pool = 16 * 1024 * kv_per_tok  # 16 seqs x 1024 ctx, bf16
         assert int8_bytes + kv_pool < 15.5e9
         assert 2 * p8 > 16e9  # and bf16 provably does NOT fit
+
+
+class TestInt8KVCache:
+    """int8 KV pools (per-token-per-head scales) vs the bf16 cache:
+    same model, same inputs — logits must agree within quantization
+    tolerance through prefill, continuation, and decode."""
+
+    def _setup(self, dtype):
+        from llmq_tpu.models.llama import (get_config, init_kv_pages,
+                                           init_params)
+        cfg = get_config("llama3-tiny", max_seq_len=128, pallas=False,
+                         n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        B, pages_per_seq, page = 2, 8, 16
+        cache = init_kv_pages(cfg, B * pages_per_seq + 1, page,
+                              dtype=dtype)
+        bt = np.zeros((B, pages_per_seq), np.int32)
+        n = 1
+        for b in range(B):
+            for p in range(pages_per_seq):
+                bt[b, p] = n
+                n += 1
+        return cfg, params, cache, jnp.asarray(bt)
+
+    def test_prefill_and_decode_match_bf16(self):
+        from llmq_tpu.models.llama import (forward_decode,
+                                           forward_prefill)
+
+        T = 24
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, T), 5, 500,
+                                  jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(T), (2, T))
+        lengths = jnp.full((2,), T, jnp.int32)
+
+        outs = {}
+        for name, dt in (("bf16", None), ("int8", jnp.int8)):
+            cfg, params, cache, bt = self._setup(dt)
+            if name == "int8":
+                assert set(cache) == {"k", "v", "k_scale", "v_scale"}
+            logits, cache = forward_prefill(params, cfg, toks, positions,
+                                            lengths, cache, bt)
+            # one decode step on top of the prefilled history
+            last = toks[:, -1]
+            pos = jnp.full((2,), T, jnp.int32)
+            page_of = bt[jnp.arange(2), pos // 16]
+            slot_of = pos % 16
+            dlogits, cache = forward_decode(params, cfg, last, pos, cache,
+                                            bt)
+            outs[name] = (np.asarray(logits), np.asarray(dlogits))
+
+        # int8 KV quantization error is ~0.5% per value; logits are
+        # sums over D=32 — tolerance is loose but far below the
+        # bf16-vs-int8-weights gap that would indicate a real bug.
+        p_ref, d_ref = outs["bf16"]
+        p_q, d_q = outs["int8"]
+        ref_scale = np.abs(p_ref).max()
+        assert np.abs(p_q - p_ref).max() < 0.05 * ref_scale, (
+            np.abs(p_q - p_ref).max(), ref_scale)
+        assert np.abs(d_q - d_ref).max() < 0.05 * np.abs(d_ref).max()
+
+    def test_int8_cache_layout(self):
+        from llmq_tpu.models.llama import init_kv_pages, llama3_tiny
+        cfg = llama3_tiny(n_kv_heads=2)
+        c = init_kv_pages(cfg, 9, 16, dtype=jnp.int8)
+        assert c["k"].dtype == jnp.int8
+        assert c["k_scale"].shape == (cfg.n_layers, 9, 2, 16)
+        assert c["k_scale"].dtype == jnp.bfloat16
+
+    def test_build_engine_int8_kv_generates(self):
+        """config.model.kv_quantization='int8' through build_engine:
+        pools carry scale leaves and generation + turn-2 KV reuse work
+        (CPU, tiny model — the serving wiring, not the kernel)."""
+        from llmq_tpu.core.config import default_config
+        from llmq_tpu.engine import build_engine
+
+        cfg = default_config()
+        cfg.executor.backend = "jax"
+        cfg.model.name = "llama3-tiny"
+        cfg.model.max_seq_len = 128
+        cfg.model.kv_quantization = "int8"
+        cfg.executor.max_batch_size = 2
+        cfg.executor.page_size = 16
+        cfg.executor.kv_pages = 17
+        cfg.executor.prefill_buckets = [16]
+        cfg.executor.decode_chunk = 4
+        eng = build_engine(cfg, warmup=False)
+        assert "k_scale" in eng.executor.cache
+        eng.start()
+        try:
+            r1 = eng.generate("hi there", max_new_tokens=4,
+                              conversation_id="c")
+            assert r1.tokens
+            r2 = eng.generate(" again", max_new_tokens=4,
+                              conversation_id="c")
+            assert r2.cached_tokens > 0
+        finally:
+            eng.stop()

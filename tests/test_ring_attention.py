@@ -48,3 +48,41 @@ class TestRingAttention:
         ref = causal_prefill_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestLongContextPrefillSP:
+    def test_full_model_sp_matches_dense_prefill(self):
+        """forward_prefill_sp over sp=4 ≡ the dense paged prefill: the
+        model-level long-context path is exact, not approximate."""
+        from llmq_tpu.models.llama import (forward_prefill,
+                                           forward_prefill_sp,
+                                           get_config, init_kv_pages,
+                                           init_params)
+
+        cfg = get_config("llama3-tiny", max_seq_len=128, pallas=False,
+                         dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        Bm, Tm = 2, 64
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (Bm, Tm), 5,
+                                    cfg.vocab_size - 5, jnp.int32)
+
+        mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        sp_logits = np.asarray(
+            forward_prefill_sp(params, cfg, tokens, mesh))
+
+        page = 16
+        pages_per_seq = cfg.max_seq_len // page
+        cache = init_kv_pages(cfg, Bm * pages_per_seq + 1, page)
+        bt = np.zeros((Bm, pages_per_seq), np.int32)
+        nxt = 1
+        for b in range(Bm):
+            for p in range(pages_per_seq):
+                bt[b, p] = nxt
+                nxt += 1
+        positions = jnp.broadcast_to(jnp.arange(Tm), (Bm, Tm))
+        lengths = jnp.full((Bm,), Tm, jnp.int32)
+        dense_logits, _ = forward_prefill(
+            params, cfg, tokens, positions, lengths, cache,
+            jnp.asarray(bt))
+        np.testing.assert_allclose(sp_logits, np.asarray(dense_logits),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,196 @@
+"""Remote-engine HTTP transport (BASELINE config #5's dispatch half).
+
+The reference fabricates worker URLs and never dispatches to them
+(scheduler.go:299-301; SURVEY §3.5). These tests prove this framework's
+transport is real: a gateway LoadBalancer routes drained messages over
+HTTP to peer serve processes — with session affinity, EWMA feedback,
+and failover through the health state machine when a peer's engine
+dies. The last test runs two genuine OS processes (``python -m
+llmq_tpu serve``) behind one gateway router and kills one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from llmq_tpu.api.server import ApiServer
+from llmq_tpu.core.config import LoadBalancerConfig, default_config
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.engine import ByteTokenizer, EchoExecutor, InferenceEngine
+from llmq_tpu.loadbalancer import (EngineRouter, HttpEngineClient,
+                                   LoadBalancer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _engine() -> InferenceEngine:
+    eng = InferenceEngine(EchoExecutor(batch_size=4), ByteTokenizer(),
+                          enable_metrics=False)
+    eng.start()
+    return eng
+
+
+def _serve_pair():
+    """Two in-process engines, each behind its own REST API server."""
+    engines, servers, urls = [], [], []
+    for i in range(2):
+        eng = _engine()
+        api = ApiServer(default_config(), engine=eng)
+        port = api.start(host="127.0.0.1", port=0)
+        engines.append(eng)
+        servers.append(api)
+        urls.append(f"http://127.0.0.1:{port}")
+    return engines, servers, urls
+
+
+def test_http_client_generates():
+    engines, servers, urls = _serve_pair()
+    try:
+        client = HttpEngineClient(urls[0])
+        assert client.healthy()
+        msg = Message(id="t1", content="hello transport", user_id="u")
+        client.process_fn(None, msg)
+        assert msg.response == "hello transport"   # echo engine
+        assert msg.metadata["usage"]["completion_tokens"] > 0
+    finally:
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_http_client_reports_dead_engine_unhealthy():
+    engines, servers, urls = _serve_pair()
+    try:
+        client = HttpEngineClient(urls[0])
+        assert client.healthy()
+        engines[0].stop()      # server still up; engine thread gone
+        assert not client.healthy()
+    finally:
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_gateway_routes_with_affinity_and_failover():
+    engines, servers, urls = _serve_pair()
+    lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                         health_check_interval=0.0))
+    router = EngineRouter(lb)
+    try:
+        router.register_remote(urls[0], endpoint_id="eng0")
+        router.register_remote(urls[1], endpoint_id="eng1")
+
+        # Conversation affinity: every turn of one conversation lands
+        # on the same remote endpoint.
+        seen = set()
+        for i in range(4):
+            msg = Message(id=f"a{i}", content=f"turn {i}", user_id="u",
+                          conversation_id="conv-x")
+            router.process_fn(None, msg)
+            assert msg.response == f"turn {i}"
+            seen.add(msg.metadata["endpoint_id"])
+        assert len(seen) == 1
+        sticky = seen.pop()
+
+        # Kill the sticky endpoint's ENGINE (its HTTP server stays up),
+        # advance the health machine, and verify traffic fails over.
+        victim = 0 if sticky == "eng0" else 1
+        engines[victim].stop()
+        for _ in range(4):     # degrade → unhealthy takes 3 failures
+            lb.check_health_once()
+        msg = Message(id="f1", content="after failover", user_id="u",
+                      conversation_id="conv-x")
+        router.process_fn(None, msg)
+        assert msg.response == "after failover"
+        assert msg.metadata["endpoint_id"] != sticky
+    finally:
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_health(url: str, deadline_s: float = 30.0) -> None:
+    deadline = time.time() + deadline_s
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/health", timeout=2) as r:
+                if r.status == 200:
+                    data = json.loads(r.read().decode())
+                    if data.get("engine") == "running":
+                        return
+        except OSError as e:
+            last = e
+        time.sleep(0.1)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+def test_two_os_process_serve_failover():
+    """Two real ``serve`` processes, one gateway router: dispatch over
+    HTTP, then SIGKILL one host and fail over through the probe."""
+    ports = [_free_port(), _free_port()]
+    env = dict(os.environ)
+    env["LLMQ_QUEUE_ENABLE_METRICS"] = "false"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "llmq_tpu", "--backend", "echo",
+             "--host", "127.0.0.1", "--port", str(p), "serve"],
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        for p in ports
+    ]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                         health_check_interval=0.0))
+    router = EngineRouter(lb)
+    try:
+        for u in urls:
+            _wait_health(u)
+        router.register_remote(urls[0], endpoint_id="host0")
+        router.register_remote(urls[1], endpoint_id="host1")
+
+        used = set()
+        for i in range(6):
+            msg = Message(id=f"m{i}", content=f"req {i}", user_id="u",
+                          priority=Priority.HIGH)
+            router.process_fn(None, msg)
+            assert msg.response == f"req {i}"
+            used.add(msg.metadata["endpoint_id"])
+        assert used == {"host0", "host1"}   # round-robin over both hosts
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        for _ in range(4):
+            lb.check_health_once()
+        for i in range(4):
+            msg = Message(id=f"k{i}", content=f"post-kill {i}",
+                          user_id="u")
+            router.process_fn(None, msg)
+            assert msg.response == f"post-kill {i}"
+            assert msg.metadata["endpoint_id"] == "host1"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

@@ -108,3 +108,49 @@ class TestWarmup:
                               bt, np.zeros(4, np.float32),
                               np.ones(4, np.int32))
         assert out.shape == (4, 4)
+
+    def test_export_cache_roundtrip(self, tmp_path, monkeypatch):
+        """Warm restart via the jax.export disk cache: second warmup
+        deserializes every program (no re-lowering) and serves outputs
+        identical to the freshly-compiled path."""
+        monkeypatch.setenv("LLMQ_EXPORT_CACHE_DIR", str(tmp_path))
+
+        ex = build()
+        ex.warmup()
+        assert len(list(tmp_path.glob("*.jaxexp"))) == 6   # all exported
+
+        bt = np.zeros((4, ex.spec.max_pages_per_seq), np.int32)
+        bt[0, :2] = [1, 2]
+        first = ex.prefill([5, 6, 7], 0, bt[0], 0.0, 0)
+        toks = np.full(4, first, np.int32)
+        pos = np.full(4, 3, np.int32)
+        out_cold = ex.decode_chunk(toks, pos, bt, np.zeros(4, np.float32),
+                                   np.full(4, 4, np.int32))
+
+        ex2 = build()   # same geometry → cache hit for every program
+        ex2.warmup()
+        first2 = ex2.prefill([5, 6, 7], 0, bt[0], 0.0, 0)
+        out_warm = ex2.decode_chunk(toks, pos, bt,
+                                    np.zeros(4, np.float32),
+                                    np.full(4, 4, np.int32))
+        assert first == first2
+        assert (out_cold[0] == out_warm[0]).all()
+
+    def test_export_cache_key_tracks_code(self, tmp_path, monkeypatch):
+        """Editing model/ops source must change the cache key — a stale
+        artifact silently serving old code is the failure mode."""
+        monkeypatch.setenv("LLMQ_EXPORT_CACHE_DIR", str(tmp_path))
+        ex = build()
+        k1 = ex._export_cache_key()
+        import llmq_tpu.models as m
+        import os
+        llama_path = os.path.join(os.path.dirname(m.__file__), "llama.py")
+        orig = open(llama_path).read()
+        try:
+            with open(llama_path, "a") as f:
+                f.write("\n# cache-key probe\n")
+            k2 = ex._export_cache_key()
+        finally:
+            with open(llama_path, "w") as f:
+                f.write(orig)
+        assert k1 != k2
