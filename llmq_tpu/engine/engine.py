@@ -472,21 +472,31 @@ class InferenceEngine:
         self._ingest()
         self._expire_pins()
         # Everything BEFORE the reconcile overlaps the in-flight chunk's
-        # device compute: resolving first tokens is a fetch of results
-        # that ran ahead of the chunk on the device queue, and admission
-        # + prefill dispatches only queue more programs behind it
-        # (preemption and page-shedding — which WOULD touch rows the
-        # chunk is still decoding — are deferred while one is in
-        # flight; see _admit/_alloc_pages).
-        resolved = self._resolve_prefills()
+        # device compute: admission + prefill dispatches only queue more
+        # programs behind it (preemption and page-shedding — which WOULD
+        # touch rows the chunk is still decoding — are deferred while
+        # one is in flight; see _admit/_alloc_pages).
         admitted = self._admit()       # free slots only while in flight
         prefilled = self._advance_prefill()
         if self._chunk_inflight is not None:
             infl = self._chunk_inflight
+            # Speculate BEFORE the blocking resolve: a just-admitted
+            # sequence must still hold an UNRESOLVED first_handle at
+            # the speculation decision so it enters via the join plan
+            # (device-side override). Resolving first would flip it to
+            # prefilled-but-not-in-chunk → geometry_changed → no
+            # speculation → its tokens wait a whole extra reconcile
+            # cycle (measured: realtime tail_ms p99 +190 ms when the
+            # fetch-wait servicing made resolves early).
             nxt = None
             if (not self._has_scheduling_work()
                     and not self._geometry_changed(infl)):
                 nxt = self._dispatch_speculative(infl)
+            # Resolve AFTER dispatch, BEFORE processing: join rows'
+            # first tokens must commit before any of their chunk rows
+            # do (the chunk being processed may contain join rows from
+            # the previous cycle).
+            self._resolve_prefills()
             self._process_chunk(infl)
             self._chunk_inflight = nxt
             if nxt is None:
@@ -507,7 +517,15 @@ class InferenceEngine:
                 self._decode_once()
             self._set_gauges()
             return True
+        # No chunk in flight: DISPATCH before resolving — a final
+        # prefill chunk dispatched this step still holds an unresolved
+        # first_handle, so it joins this decode chunk device-to-device
+        # (resolving first would block ~1 RTT and then decode without
+        # the join). Sync executors never produce first_handles, so
+        # the join-commit ordering (first token at resolve, rows at
+        # the next reconcile) is preserved on every path.
         stepped = self._decode_once()
+        resolved = self._resolve_prefills()
         return resolved or admitted or prefilled or stepped
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
@@ -1061,6 +1079,12 @@ class InferenceEngine:
         ~14 on 1B — instead of a flat 16 that costs 8B realtime
         arrivals ~230 ms of admission delay before prefill starts."""
         if not self._pending or self._pending[0][0] > int(Priority.HIGH):
+            # No urgent waiter → full chunks. (An occupancy-based
+            # "latency mode" with half-size chunks was tried and
+            # REVERTED: on high-RTT runtimes the pipelined chunk
+            # cadence is (RTT + compute)/2, so doubling the chunk
+            # count cost more tail latency at 5 req/s than the halved
+            # admission wait saved — p99 553→767 ms measured.)
             return 1 << 30
         if self._pending[0][0] > int(Priority.REALTIME):
             return 16
