@@ -539,77 +539,92 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     curve = []
     max_ok_rate = 0.0
     headline = None
-    for rate in rates:
-        # Duration sized for the realtime sample target at this rate
-        # (bounded: the full sweep must fit the driver's bench window).
-        dur = max(duration_s, min(150.0,
-                                  min_realtime_n / (rate * rt_share)))
-        rng = random.Random(7)
-        handles = []
-        log(f"[poisson-tpu] {rate:.1f} req/s for {dur:.0f}s ...")
-        t_start = time.perf_counter()
-        next_arrival = t_start
-        n_sent = 0
-        while time.perf_counter() - t_start < dur:
-            now = time.perf_counter()
-            if now < next_arrival:
-                time.sleep(min(0.002, next_arrival - now))
-                continue
-            next_arrival += rng.expovariate(rate)
-            h = engine.submit(GenRequest(
-                id=f"pt{rate}-{n_sent}",
-                prompt=f"load test request {n_sent % 50}",
-                priority=sample_tier(rng, TPU_TIER_MIX),
-                max_new_tokens=24))
-            handles.append(h)
-            n_sent += 1
-        # One SHARED drain deadline: a wedged engine must bound the
-        # bench, not stall it per-handle.
-        deadline = time.perf_counter() + 90.0
-        for h in handles:
-            if not h.wait(max(0.0, deadline - time.perf_counter())):
-                break
-        # Quiesce between rate points: cancel any backlog so the next
-        # point measures ITS offered load, not a saturated predecessor's
-        # leftovers.
-        leftovers = 0
-        for h in handles:
-            if not h.done:
-                h.cancel()
-                leftovers += 1
-        if leftovers:
-            quiesce = time.perf_counter() + 30.0
-            while time.perf_counter() < quiesce:
-                s = engine.get_stats()
-                if s["pending"] == 0 and s["active"] == 0:
+    # GC discipline for the latency measurement: freeze the warmed-up
+    # object graph and disable cyclic collection during rate points
+    # (collect explicitly between them). CPython gen-2 pauses in the
+    # scheduling thread showed up as 100-200 ms realtime tail events.
+    import gc
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        for rate in rates:
+            # Duration sized for the realtime sample target at this rate
+            # (bounded: the full sweep must fit the driver's bench window).
+            dur = max(duration_s, min(150.0,
+                                      min_realtime_n / (rate * rt_share)))
+            rng = random.Random(7)
+            handles = []
+            log(f"[poisson-tpu] {rate:.1f} req/s for {dur:.0f}s ...")
+            t_start = time.perf_counter()
+            next_arrival = t_start
+            n_sent = 0
+            while time.perf_counter() - t_start < dur:
+                now = time.perf_counter()
+                if now < next_arrival:
+                    time.sleep(min(0.002, next_arrival - now))
+                    continue
+                next_arrival += rng.expovariate(rate)
+                h = engine.submit(GenRequest(
+                    id=f"pt{rate}-{n_sent}",
+                    prompt=f"load test request {n_sent % 50}",
+                    priority=sample_tier(rng, TPU_TIER_MIX),
+                    max_new_tokens=24))
+                handles.append(h)
+                n_sent += 1
+            # One SHARED drain deadline: a wedged engine must bound the
+            # bench, not stall it per-handle.
+            deadline = time.perf_counter() + 90.0
+            for h in handles:
+                if not h.wait(max(0.0, deadline - time.perf_counter())):
                     break
-                time.sleep(0.1)
-        lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
-        completed = 0
-        for h in handles:
-            if h.done and h.result.finish_reason in ("eos", "length"):
-                completed += 1
-                lat[h.request.priority.tier_name].append(h.latency)
-        point: Dict = {"offered_rate": rate, "duration_s": round(dur, 0),
-                       "sent": n_sent, "completed": completed,
-                       "cancelled": leftovers}
-        tier_report(lat, point, f"poisson-tpu@{rate:g}")
-        point["decomp"] = _decomp(handles)
-        point["decomp_realtime"] = _decomp(handles, "realtime")
-        # The tunnel-free projection: the measured critical path carries
-        # ~2 host↔device round-trips (prefill-sample fetch + chunk
-        # fetch — see decomp first_sample/tail); on a real TPU VM the
-        # RTT is ~0.2 ms. Explicit arithmetic, not a measurement.
-        point["realtime_p99_minus_2rtt_ms"] = (
-            round(point["realtime"]["p99_ms"] - 2 * rtt_ms, 2)
-            if point["realtime"]["n"] > 0 else None)
-        curve.append(point)
-        rt_p99 = point["realtime"]["p99_ms"]
-        if (point["realtime"]["n"] > 0 and completed >= n_sent * 0.95
-                and rt_p99 <= p99_gate_ms):
-            max_ok_rate = rate
-        if headline is None:
-            headline = point
+            # Quiesce between rate points: cancel any backlog so the next
+            # point measures ITS offered load, not a saturated predecessor's
+            # leftovers.
+            leftovers = 0
+            for h in handles:
+                if not h.done:
+                    h.cancel()
+                    leftovers += 1
+            if leftovers:
+                quiesce = time.perf_counter() + 30.0
+                while time.perf_counter() < quiesce:
+                    s = engine.get_stats()
+                    if s["pending"] == 0 and s["active"] == 0:
+                        break
+                    time.sleep(0.1)
+            lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
+            completed = 0
+            for h in handles:
+                if h.done and h.result.finish_reason in ("eos", "length"):
+                    completed += 1
+                    lat[h.request.priority.tier_name].append(h.latency)
+            point: Dict = {"offered_rate": rate, "duration_s": round(dur, 0),
+                           "sent": n_sent, "completed": completed,
+                           "cancelled": leftovers}
+            tier_report(lat, point, f"poisson-tpu@{rate:g}")
+            point["decomp"] = _decomp(handles)
+            point["decomp_realtime"] = _decomp(handles, "realtime")
+            # The tunnel-free projection: the measured critical path carries
+            # ~2 host↔device round-trips (prefill-sample fetch + chunk
+            # fetch — see decomp first_sample/tail); on a real TPU VM the
+            # RTT is ~0.2 ms. Explicit arithmetic, not a measurement.
+            point["realtime_p99_minus_2rtt_ms"] = (
+                round(point["realtime"]["p99_ms"] - 2 * rtt_ms, 2)
+                if point["realtime"]["n"] > 0 else None)
+            curve.append(point)
+            rt_p99 = point["realtime"]["p99_ms"]
+            if (point["realtime"]["n"] > 0 and completed >= n_sent * 0.95
+                    and rt_p99 <= p99_gate_ms):
+                max_ok_rate = rate
+            if headline is None:
+                headline = point
+            gc.collect()             # between points, outside measurement
+    finally:
+        # GC discipline must not leak past this sweep (main()
+        # runs the 8B sweep in the same process).
+        gc.enable()
+        gc.unfreeze()
     engine.stop()
     out: Dict = dict(headline or {})
     out["model"] = cfg.name
