@@ -1296,12 +1296,22 @@ class InferenceEngine:
         flight (resolve-only waits) the admission path MAY shed
         mid-prefill sequences — callers holding snapshots must
         re-validate them after the wait (see _resolve_prefills)."""
+        t0 = time.perf_counter()
+        warned = False
         while not ev.wait(0.002):
             if self._wake.is_set():
                 self._wake.clear()
                 self._ingest()
                 if self._admit():
                     self._advance_prefill()
+            if not warned and time.perf_counter() - t0 > 5.0:
+                # Rare multi-second device/tunnel stalls (observed ~1
+                # per 10 bench sweeps, once 116 s in r4) poison a whole
+                # latency run — make them attributable after the fact.
+                log.warning("device transfer stalled > 5 s "
+                            "(engine %s keeps servicing arrivals)",
+                            self.name)
+                warned = True
 
     def _process_chunk(self, infl: _InflightChunk) -> None:
         """Commit an in-flight chunk's tokens. Uses the dispatch-time

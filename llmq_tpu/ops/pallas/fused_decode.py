@@ -437,13 +437,13 @@ def fused_decode_attention_pallas(
             pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
             pl.BlockSpec((R, GD), lambda t, c, *_: (t, 0)),
             pl.BlockSpec((R, 1, 8, S), lambda t, c, *_: (t, c, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((R, H, 1), jnp.float32),
@@ -829,17 +829,17 @@ def fused_decode_attention_q8_pallas(
             pl.BlockSpec((R, Hkv, page_size), lambda t, c, *_: (t, 0, 0)),
             pl.BlockSpec((R, Hkv, page_size), lambda t, c, *_: (t, 0, 0)),
             pl.BlockSpec((R, 1, 8, S), lambda t, c, *_: (t, c, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((R, H, D), lambda t, c, *_: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((R, H, 1), jnp.float32),

@@ -148,12 +148,12 @@ def kv_cache_write_pallas(
         in_specs=[
             pl.BlockSpec((n_pad, GD), lambda c, *_: (0, 0)),
             pl.BlockSpec((n_pad, GD), lambda c, *_: (0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, page_size, GD), k_pool.dtype),
@@ -321,12 +321,12 @@ def kv_prefill_write_pallas(
         in_specs=[
             pl.BlockSpec((n_wp * page_size, GD), lambda c, *_: (0, 0)),
             pl.BlockSpec((n_wp * page_size, GD), lambda c, *_: (0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((page_size, GD), k_pool.dtype),

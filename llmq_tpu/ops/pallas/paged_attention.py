@@ -245,8 +245,8 @@ def paged_decode_attention_pallas(
         grid=(B, num_chunks),
         in_specs=[
             pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, H, GD), lambda b, c, *_: (b, 0, 0)),
         scratch_shapes=[

@@ -238,8 +238,8 @@ def paged_prefill_attention_pallas(
         grid=(n_qb, num_chunks),
         in_specs=[
             pl.BlockSpec((TbH, GD), lambda b, c, *_: (b, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((TbH, GD), lambda b, c, *_: (b, 0)),
         scratch_shapes=[
