@@ -508,7 +508,12 @@ def paged_decode_step_q8(q, k_new, v_new, pools, block_tables, seq_lens,
     vq, vscale = quantize_kv_rows(v_new)
 
     from llmq_tpu.ops.pallas.fused_decode import fused_kernel_viable
-    fused_ok = (k_pool.shape[2] % 8 == 0
+    # page_size % 128: a scale page is a (H_kv, page_size) block whose
+    # LANE dim is page_size — Mosaic rejects the page DMA slice when it
+    # isn't lane-tile aligned (found by an on-chip A/B at ps=16).
+    # Serving configs for int8 KV want 128-token pages anyway
+    # (per-page DMA cost); smaller pages fall back to the pure path.
+    fused_ok = (k_pool.shape[2] % 128 == 0
                 and k_pool.shape[3] // D == ks_pool.shape[2] == 8
                 and fused_kernel_viable(
                     B, k_pool.shape[2], block_tables.shape[1],

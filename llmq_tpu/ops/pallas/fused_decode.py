@@ -697,8 +697,12 @@ def _fused_kernel_q8(
             """(2, R, ppc, Hkv, ps) scratch → (R, H, S) f32 multiplier:
             pages lane-concatenated into the chunk's S axis, groups
             expanded to their n_rep query heads (g-major head order —
-            matches the block-diagonal q layout)."""
-            pages = [s_scratch[slot, :, j] for j in range(ppc)]
+            matches the block-diagonal q layout). Reads the slot's
+            scratch ONCE and slices the VALUE — a mixed ref-slice
+            (``[slot, :, j]``) mis-lowered on real Mosaic (caught by an
+            on-chip A/B; interpret mode masked it)."""
+            full = s_scratch[slot]                   # (R, ppc, Hkv, ps)
+            pages = [full[:, j] for j in range(ppc)]
             hs = (pages[0] if ppc == 1
                   else jnp.concatenate(pages, axis=2))     # (R, Hkv, S)
             rows = []
