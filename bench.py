@@ -26,7 +26,9 @@ LLMQ_BENCH_BATCH, LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SEQ,
 LLMQ_BENCH_CHUNK, LLMQ_BENCH_PAGE, LLMQ_BENCH_SLA_MODEL,
 LLMQ_BENCH_SLA_QUANT, LLMQ_BENCH_TPU_POISSON_RATES,
 LLMQ_BENCH_TPU_POISSON_SECS, LLMQ_BENCH_TPU_SLOTS,
-LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU.
+LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU,
+LLMQ_BENCH_PREFIX_CACHE (=0 disables the radix prefix KV cache in the
+SLA sweeps for A/B comparison).
 """
 
 from __future__ import annotations
@@ -444,7 +446,8 @@ def _decomp(handles: List, tier: Optional[str] = None) -> Dict:
     much of it is host↔device round-trip rather than engine time."""
     comps: Dict[str, List[float]] = {
         "queue_ms": [], "first_sample_ms": [], "tail_ms": [],
-        "first_token_ms": []}
+        "first_token_ms": [], "cached_first_token_ms": [],
+        "uncached_first_token_ms": []}
     for h in handles:
         if not (h.done and h.result
                 and h.result.finish_reason in ("eos", "length")):
@@ -465,7 +468,14 @@ def _decomp(handles: List, tier: Optional[str] = None) -> Dict:
         if "prefill_done" in m:
             comps["tail_ms"].append(t_fin - m["prefill_done"])
         if "first_token" in m:
-            comps["first_token_ms"].append(m["first_token"] - t_sub)
+            ft = m["first_token"] - t_sub
+            comps["first_token_ms"].append(ft)
+            # Prefix-cache split: requests whose KV prefix was served
+            # from cache vs. full prefills — the direct measurement of
+            # what the radix cache buys on the failing first-token gate.
+            key = ("cached_first_token_ms" if h.result.cached_tokens > 0
+                   else "uncached_first_token_ms")
+            comps[key].append(ft)
     out = {}
     for k, xs in comps.items():
         if xs:
@@ -521,8 +531,17 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     warmup_s = time.perf_counter() - t0
     log(f"[poisson-tpu] warmup {warmup_s:.1f}s "
         f"(step ~{ex.step_ms or 0:.2f}ms)")
+    # Radix prefix cache ON by default (LLMQ_BENCH_PREFIX_CACHE=0 turns
+    # it off for A/B runs): the load mix repeats prompts, so the cache
+    # converts most prefills into tail-only work — the biggest lever on
+    # the realtime first_token_ms gate. Hit/served-token counts are
+    # reported per rate point below.
+    pc = None
+    if os.environ.get("LLMQ_BENCH_PREFIX_CACHE", "1") != "0":
+        from llmq_tpu.core.config import PrefixCacheConfig
+        pc = PrefixCacheConfig(enabled=True)
     engine = InferenceEngine(ex, tok, enable_metrics=False,
-                             max_decode_steps=32)
+                             max_decode_steps=32, prefix_cache=pc)
     engine.start()
 
     # Discarded warm burst: the first requests after a fresh executor
@@ -547,6 +566,11 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     gc.collect()
     gc.freeze()
     gc.disable()
+    # Seed AFTER the warm burst above: its discarded requests already
+    # moved the cumulative prefix counters, and the first rate point's
+    # delta must not carry them.
+    pc_prev = {"hits": engine.prefix_hits, "misses": engine.prefix_misses,
+               "tokens": engine.cached_prefill_tokens_total}
     try:
         for rate in rates:
             # Duration sized for the realtime sample target at this rate
@@ -605,6 +629,19 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
             tier_report(lat, point, f"poisson-tpu@{rate:g}")
             point["decomp"] = _decomp(handles)
             point["decomp_realtime"] = _decomp(handles, "realtime")
+            if pc is not None:
+                # Per-point deltas of the engine's cumulative counters.
+                hits, misses = engine.prefix_hits, engine.prefix_misses
+                toks = engine.cached_prefill_tokens_total
+                d_h = hits - pc_prev["hits"]
+                d_m = misses - pc_prev["misses"]
+                point["prefix_cache_hit_rate"] = round(
+                    d_h / max(1, d_h + d_m), 4)
+                point["cached_prefill_tokens"] = toks - pc_prev["tokens"]
+                pc_prev = {"hits": hits, "misses": misses, "tokens": toks}
+                log(f"[poisson-tpu@{rate:g}] prefix cache: "
+                    f"hit_rate={point['prefix_cache_hit_rate']:.2f} "
+                    f"cached_tokens={point['cached_prefill_tokens']}")
             # The tunnel-free projection: the measured critical path carries
             # ~2 host↔device round-trips (prefill-sample fetch + chunk
             # fetch — see decomp first_sample/tail); on a real TPU VM the
@@ -625,9 +662,12 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         # runs the 8B sweep in the same process).
         gc.enable()
         gc.unfreeze()
+    prefix_stats = engine.get_stats().get("prefix_cache")
     engine.stop()
     out: Dict = dict(headline or {})
     out["model"] = cfg.name
+    if prefix_stats is not None:
+        out["prefix_cache"] = prefix_stats
     out["quant"] = quant or "bf16"
     out["slots"] = slots
     out["host_device_rtt_ms"] = round(rtt_ms, 1)

@@ -84,6 +84,14 @@ class App:
             self.engine = build_engine(cfg, warmup=(cfg.executor.backend == "jax"))
             # BASELINE config #3: conversation eviction frees pinned KV.
             self.engine.attach_conversation_manager(self.state_manager)
+            # Cache-aware admission (docs/prefix_cache.md): token-sized
+            # resource requests are charged only their expected-NEW
+            # prefill tokens, not context the prefix cache will serve.
+            eng = self.engine
+            self.resource_scheduler.set_prefill_estimator(
+                lambda md: eng.prefill_estimate(
+                    str(md.get("conversation_id", "")),
+                    int(md.get("prompt_tokens", 0) or 0)))
             if cfg.executor.backend == "jax":
                 self._register_chip_resources()
 
@@ -157,8 +165,11 @@ class App:
         for v in (mesh or {}).values():
             n_chips *= max(1, int(v))
         n_chips = min(n_chips, max(1, topo.num_chips))
-        self.resource_scheduler.register_topology_resources(
+        own = self.resource_scheduler.register_topology_resources(
             topo, chips_per_resource=max(n_chips, 1))
+        #: Resources THIS process registered — the set its heartbeat
+        #: vouches for (never externally-registered workers).
+        self._own_resource_ids = [r.id for r in own]
         try:
             alloc = self.resource_scheduler.request_resource_now(
                 ResourceRequest(
@@ -179,25 +190,35 @@ class App:
                  topo.total_hbm_gb)
 
     def _start_chip_heartbeat(self) -> None:
-        """Keep the registered chip resources ALIVE while the engine is:
+        """Keep THIS engine's chip resource ALIVE while the engine is:
         the scheduler's monitor marks resources offline on heartbeat
         timeout (reference :477-492 semantics), and a serving process
         that registers chips but never heartbeats them reports its own
         chips offline 30 s in. The engine's liveness IS the heartbeat
         signal — a dead engine thread stops the beat and the scheduler
-        correctly ages its chips out."""
+        correctly ages its chips out.
+
+        Only resources THIS process registered (its own topology slice,
+        which includes the one backing ``self.engine_allocation``) are
+        beaten: beating every resource with a ``tpu`` capability would
+        vouch for externally-registered workers this process knows
+        nothing about, keeping dead ones online forever (round-5
+        ADVICE)."""
         import threading
 
         sched = self.resource_scheduler
         interval = max(1.0, sched.config.heartbeat_timeout / 3.0)
+        own = list(getattr(self, "_own_resource_ids", []))
+        alloc = self.engine_allocation
+        if alloc is not None and alloc.resource_id not in own:
+            own.append(alloc.resource_id)
 
         def beat() -> None:
             while not self._hb_stop.wait(interval):
                 if self.engine is None or not self.engine.running:
                     continue
-                for r in sched.resources():
-                    if "tpu" in r.capabilities:
-                        sched.heartbeat(r.id)
+                for rid in own:
+                    sched.heartbeat(rid)
 
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=beat, daemon=True,

@@ -65,10 +65,14 @@ class _SSEStream:
     """Dispatch payload marker: iterate and write each yielded string as
     it is produced (``text/event-stream``), instead of buffering one
     JSON body. Events must already be SSE-framed
-    (``event:.../data:...\\n\\n``)."""
+    (``event:.../data:...\\n\\n``). ``on_close`` (idempotent) runs when
+    the HTTP handler is done with the stream — including failure paths
+    where the generator was never started, which a generator-finally
+    alone cannot cover."""
 
-    def __init__(self, events) -> None:
+    def __init__(self, events, on_close=None) -> None:
         self.events = events
+        self.on_close = on_close
 
     def __iter__(self):
         return iter(self.events)
@@ -140,7 +144,44 @@ class ApiServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        # SSE admission control: streams bypass the queue plane, so
+        # without this a stream flood grows engine pending without
+        # bound (satellite fix; see _acquire_stream_slot).
+        self._stream_mu = threading.Lock()
+        self._active_streams = 0
         self._setup_routes()
+
+    # -- SSE admission -------------------------------------------------------
+
+    def _acquire_stream_slot(self) -> None:
+        """Admission gate for the SSE path: 429 past the concurrent-
+        stream cap, 503 when the engine's pending queue is already deep
+        (shedding beats unbounded backlog — the queue plane's
+        max_queue_size bound does not cover direct engine submits)."""
+        scfg = self.config.server
+        limit = getattr(scfg, "stream_pending_limit", 0)
+        # Prefer the cheap depth probe; fall back to full stats for
+        # engine-likes that only expose get_stats.
+        depth_fn = getattr(self.engine, "pending_count", None)
+        stats_fn = getattr(self.engine, "get_stats", None)
+        if limit and limit > 0 and (depth_fn or stats_fn):
+            pending = (depth_fn() if depth_fn
+                       else stats_fn().get("pending", 0))
+            if pending >= limit:
+                raise ApiError(
+                    503, f"engine backlog too deep for streaming "
+                         f"({pending} pending >= {limit})")
+        cap = getattr(scfg, "max_concurrent_streams", 0)
+        with self._stream_mu:
+            if cap and cap > 0 and self._active_streams >= cap:
+                raise ApiError(
+                    429, f"too many concurrent streams (max {cap})")
+            self._active_streams += 1
+
+    def _release_stream_slot(self) -> None:
+        with self._stream_mu:
+            if self._active_streams > 0:
+                self._active_streams -= 1
 
     # -- routing table (parity: handlers.go:75-118) --------------------------
 
@@ -308,7 +349,22 @@ class ApiServer:
 
     def submit_message(self, req: _Request) -> Tuple[int, Any]:
         data = req.json()
-        if data.pop("stream", False):
+        stream = data.pop("stream", False)
+        if stream is None:
+            stream = False          # optional-field serializers emit null
+        if isinstance(stream, str):
+            low = stream.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                stream = True
+            elif low in ("false", "0", "no", "off", ""):
+                stream = False
+            else:
+                # A truthy-but-garbage string must be a client error,
+                # not an accidental stream (or a 500 downstream).
+                raise ApiError(400, f"invalid stream value {stream!r}")
+        elif not isinstance(stream, (bool, int)):
+            raise ApiError(400, "stream must be a boolean")
+        if stream:
             return self._stream_message(data)
         msg = self._ingest_message(data)
         return 202, {
@@ -336,32 +392,50 @@ class ApiServer:
 
         # Read the CLIENT's timeout before Message.from_dict fills the
         # dataclass default (30 s) — an unset field must get the
-        # streaming default, not be silently capped at 30 s.
+        # streaming default, not be silently capped at 30 s. Validate it
+        # HERE: a non-numeric value must 400, not 500 when the float()
+        # below would otherwise raise mid-handler.
         explicit_timeout = data.get("timeout")
+        if explicit_timeout is not None:
+            try:
+                explicit_timeout = float(explicit_timeout)
+            except (TypeError, ValueError):
+                raise ApiError(
+                    400, f"timeout must be a number, "
+                         f"got {explicit_timeout!r}") from None
         try:
             msg = Message.from_dict(data)
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"invalid message: {e}") from None
-        if not msg.id:
-            msg.id = new_id()
-        msg.created_at = msg.updated_at = time.time()
-        if self.preprocessor is not None:
-            msg = self.preprocessor.process_message(msg)
-        msg.status = MessageStatus.PROCESSING
-        self.store.record(msg)
-        if msg.conversation_id and self.state_manager is not None:
-            try:
-                self.state_manager.add_message(msg.conversation_id, msg)
-            except Exception:  # noqa: BLE001 — parity: log, don't fail
-                log.exception("conversation update failed for %s", msg.id)
+        # Admission: the SSE path bypasses queue admission entirely, so
+        # it carries its own gate (429 stream cap / 503 backlog shed).
+        self._acquire_stream_slot()
+        try:
+            if not msg.id:
+                msg.id = new_id()
+            msg.created_at = msg.updated_at = time.time()
+            if self.preprocessor is not None:
+                msg = self.preprocessor.process_message(msg)
+            msg.status = MessageStatus.PROCESSING
+            self.store.record(msg)
+            if msg.conversation_id and self.state_manager is not None:
+                try:
+                    self.state_manager.add_message(msg.conversation_id, msg)
+                except Exception:  # noqa: BLE001 — parity: log, don't fail
+                    log.exception("conversation update failed for %s",
+                                  msg.id)
 
-        tokens: "Queue[int]" = Queue()
-        handle = self.engine.submit(GenRequest.from_message(msg),
-                                    on_token=tokens.put)
-        tokenizer = self.engine.tokenizer
-        timeout = (float(explicit_timeout)
-                   if explicit_timeout and float(explicit_timeout) > 0
-                   else 120.0)
+            tokens: "Queue[int]" = Queue()
+            handle = self.engine.submit(GenRequest.from_message(msg),
+                                        on_token=tokens.put)
+            tokenizer = self.engine.tokenizer
+            timeout = (explicit_timeout
+                       if explicit_timeout and explicit_timeout > 0
+                       else 120.0)
+        except BaseException:
+            # Setup failed after the slot was taken — give it back.
+            self._release_stream_slot()
+            raise
 
         def events():
             yield ("event: start\ndata: "
@@ -450,7 +524,37 @@ class ApiServer:
                 msg.status = MessageStatus.FAILED
                 msg.updated_at = time.time()
                 raise
-        return 200, _SSEStream(events())
+
+        # Idempotent slot release: reachable from the generator's
+        # finally (normal completion, disconnect, mid-stream failure)
+        # AND from the handler's on_close (header-write failure before
+        # the generator ever starts — a never-started generator's
+        # finally does not run). In that never-started case the
+        # generator's own cleanup (engine cancel + terminal message
+        # state) also never fired, so release_once does it: otherwise
+        # the engine decodes a full response for a dead client and the
+        # stored record sits in PROCESSING forever.
+        released = threading.Event()
+        started = threading.Event()
+
+        def release_once():
+            if released.is_set():
+                return
+            released.set()
+            self._release_stream_slot()
+            if not started.is_set():
+                handle.cancel()
+                msg.status = MessageStatus.FAILED
+                msg.updated_at = time.time()
+
+        def guarded():
+            started.set()
+            try:
+                yield from events()
+            finally:
+                release_once()
+
+        return 200, _SSEStream(guarded(), on_close=release_once)
 
     def get_message(self, req: _Request) -> Tuple[int, Any]:
         msg = self.store.get(req.params["id"])
@@ -737,13 +841,17 @@ class ApiServer:
                 if isinstance(payload, _SSEStream):
                     # Streaming: chunked, flushed per event; length
                     # unknown up front, so close delimits the body.
-                    self.send_response(status)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Cache-Control", "no-cache")
-                    self.send_header("Connection", "close")
-                    self._cors_headers()
-                    self.end_headers()
+                    # Header writes sit INSIDE the try: a client that
+                    # disconnects before headers go out must still hit
+                    # the finally (slot release / generator close), or
+                    # each such disconnect would leak a stream slot.
                     try:
+                        self.send_response(status)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Cache-Control", "no-cache")
+                        self.send_header("Connection", "close")
+                        self._cors_headers()
+                        self.end_headers()
                         for event in payload:
                             self.wfile.write(event.encode("utf-8"))
                             self.wfile.flush()
@@ -752,10 +860,16 @@ class ApiServer:
                     finally:
                         # Deterministic cleanup: closing the generator
                         # raises GeneratorExit inside it → the stream
-                        # cancels its engine request.
+                        # cancels its engine request. on_close covers
+                        # the never-started-generator case.
                         close = getattr(payload.events, "close", None)
                         if close is not None:
                             close()
+                        if payload.on_close is not None:
+                            try:
+                                payload.on_close()
+                            except Exception:  # noqa: BLE001
+                                log.exception("SSE on_close failed")
                     self.close_connection = True
                     return
                 try:

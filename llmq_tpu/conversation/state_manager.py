@@ -79,6 +79,44 @@ class StateManager:
             except Exception:  # noqa: BLE001
                 log.exception("conversation hook failed for %s", conv.id)
 
+    # -- prefix-cache handles (docs/prefix_cache.md) -------------------------
+
+    def record_prefix_handle(self, conversation_id: str,
+                             handle: Dict) -> bool:
+        """Record the engine-side prefix-KV handle for a conversation:
+        after turn N commits, the engine calls this with the committed
+        prefix length and page count. The handle describes the last
+        COMMITTED prefix (content identity), not current HBM residency
+        — the pin may be reclaimed later while the radix tree still
+        serves the blocks; live residency is what the engine metrics
+        report. Turn N+1's cache-aware admission reads it through
+        ``InferenceEngine.prefill_estimate`` when the pin is gone.
+        Stored under ``conversation.metadata["prefix_kv"]``. Deliberately does NOT
+        get-or-create (no touch hooks fire — the engine calls this with
+        its own lock released, and a touch callback would re-enter it)
+        and does NOT write the store inline: the caller is the engine's
+        scheduling thread, and a slow store would stall decode. The
+        handle describes volatile HBM state anyway — it rides along the
+        next regular save. Returns False if the conversation is unknown
+        here."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                return False
+            conv.metadata["prefix_kv"] = dict(handle)
+        return True
+
+    def prefix_handle(self, conversation_id: str) -> Optional[Dict]:
+        """The last handle recorded by :meth:`record_prefix_handle`, or
+        None. Cleared implicitly when the conversation is evicted (the
+        engine's on_evict hook drops the KV itself)."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                return None
+            h = conv.metadata.get("prefix_kv")
+            return dict(h) if isinstance(h, dict) else None
+
     # -- core API ------------------------------------------------------------
 
     def get_or_create(self, conversation_id: str, user_id: str = "") -> Conversation:

@@ -35,11 +35,19 @@ VALID_SCHEDULER_STRATEGIES = ("static", "dynamic", "adaptive", "hybrid")
 
 @dataclass
 class ServerConfig:
-    """Reference config.go:19-25."""
+    """Reference config.go:19-25, plus SSE admission control (the
+    streaming path bypasses the queue plane, so it needs its own
+    backpressure)."""
     host: str = "0.0.0.0"
     port: int = 8080
     read_timeout: float = 30.0
     write_timeout: float = 30.0
+    #: Concurrent SSE streams accepted before new ones get 429; <= 0
+    #: disables the cap.
+    max_concurrent_streams: int = 32
+    #: Engine pending-queue depth above which new streams get 503
+    #: (shed before the backlog grows unbounded); <= 0 disables.
+    stream_pending_limit: int = 256
 
 
 @dataclass
@@ -232,6 +240,29 @@ class ModelConfig:
     vocab_size: int = 0                 # 0 → model default
 
 
+VALID_PREFIX_EVICTION = ("lru", "fifo")
+
+
+@dataclass
+class PrefixCacheConfig:
+    """Radix-tree prefix KV cache (prefixcache/radix.py,
+    docs/prefix_cache.md). ``enabled: false`` is a hard off-switch —
+    the engine then behaves exactly as it did before the subsystem
+    existed (no tree, no ref sharing, no extra metrics movement)."""
+    enabled: bool = True
+    #: Cap on pages the tree may hold; 0 = bounded only by the KV pool
+    #: (pool pressure evicts zero-ref leaves on demand).
+    max_cached_pages: int = 0
+    #: "lru" (default) or "fifo" — which zero-ref leaf goes first.
+    eviction: str = "lru"
+
+    def __post_init__(self) -> None:
+        if self.eviction not in VALID_PREFIX_EVICTION:
+            raise ValueError(
+                f"unknown prefix-cache eviction policy {self.eviction!r}; "
+                f"valid: {VALID_PREFIX_EVICTION}")
+
+
 @dataclass
 class ExecutorConfig:
     """Continuous-batching engine knobs (new scope)."""
@@ -250,6 +281,7 @@ class ExecutorConfig:
     prefill_batch: int = 4
     preemption: bool = True
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
 
 
 @dataclass

@@ -131,7 +131,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         preemption=ex.preemption,
         kv_pin_ttl=ex.kv_pin_ttl,
         enable_metrics=metrics_on,
-        tier_max_wait=tier_max_wait)
-    log.info("built %s engine %s (slots=%d pages=%d page_size=%d)",
-             ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size)
+        tier_max_wait=tier_max_wait,
+        prefix_cache=getattr(ex, "prefix_cache", None))
+    log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
+             "prefix_cache=%s)",
+             ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
+             "on" if getattr(ex.prefix_cache, "enabled", False) else "off")
     return engine

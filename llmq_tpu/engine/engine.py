@@ -169,7 +169,8 @@ class _Sequence:
                  "prefilled", "order", "adopted", "prefill_ids",
                  "prefill_start", "carry", "written_ids", "rebuild",
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
-                 "first_handle", "eff_prio", "arrival")
+                 "first_handle", "eff_prio", "arrival", "prefix_match",
+                 "reuse_counted")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -213,6 +214,14 @@ class _Sequence:
         #: 151-156, which its code never consults).
         self.eff_prio = int(req.priority)
         self.arrival = 0.0
+        #: Active radix-tree prefix match (prefixcache.PrefixMatch): the
+        #: sequence holds one allocator ref per matched page (inside
+        #: ``pages``) and one lock per matched node — unlocked whenever
+        #: the pages leave the sequence (finish, shed, un-match).
+        self.prefix_match = None
+        #: Hit/miss counted for this REQUEST (first admission only —
+        #: a shed-and-rebuilt sequence must not re-count its reuse).
+        self.reuse_counted = False
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -268,6 +277,7 @@ class InferenceEngine:
         enable_metrics: bool = True,
         clock: Optional[Clock] = None,
         tier_max_wait: Optional[Dict[Priority, float]] = None,
+        prefix_cache=None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -290,12 +300,42 @@ class InferenceEngine:
 
         self.allocator = PageAllocator(self.spec.num_pages,
                                        self.spec.page_size)
+        #: Radix-tree prefix KV cache (docs/prefix_cache.md). None when
+        #: disabled — every code path below then degrades to the exact
+        #: pre-cache behavior (the config's hard off-switch).
+        #: ``prefix_cache`` accepts a core.config.PrefixCacheConfig or
+        #: anything with the same fields.
+        self._prefix_cache = None
+        if prefix_cache is not None and getattr(prefix_cache, "enabled",
+                                                False):
+            from llmq_tpu.prefixcache import PrefixCache
+            self._prefix_cache = PrefixCache(
+                self.allocator, self.spec.page_size,
+                max_pages=int(getattr(prefix_cache, "max_cached_pages", 0)),
+                policy=getattr(prefix_cache, "eviction", "lru"))
+        #: Admission-level reuse counters (engine-local so benches with
+        #: prometheus disabled can still read them): an admission that
+        #: starts from cached KV — a pinned conversation or a radix
+        #: match — is a hit; a from-scratch prefill is a miss.
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cached_prefill_tokens_total = 0
+        self._state_manager = None
         self._slots: List[Optional[_Sequence]] = [None] * self.spec.batch_size
         self._pending: List = []           # heap of (prio, order, _Sequence)
         self._inbox: List[_Sequence] = []  # submitted, not yet in heap
         self._conv_cache: Dict[str, _ConvKV] = {}
         self._conv_busy: Dict[str, int] = {}    # conv id → holder seq.order
         self._conv_drop_pending: set = set()    # dropped while busy
+        #: Token streams of conversations whose HBM pin was reclaimed
+        #: (TTL / pool pressure) while their prefix may still live in
+        #: the radix tree: a later DELETE must still be able to prune
+        #: that content (the delete contract). Maps conv id → up to 4
+        #: remembered streams (an expired pin and a later no-history
+        #: turn publish DIVERGENT branches; all must prune on delete).
+        #: Bounded FIFO; entries clear on delete. Only populated when
+        #: the prefix cache is enabled.
+        self._conv_evicted_tokens: Dict[str, List[List[int]]] = {}
         self._order = itertools.count()
         #: In-flight decode chunk (pipelined path): dispatched but not
         #: yet fetched. See _decode_once / _dispatch_speculative.
@@ -385,6 +425,12 @@ class InferenceEngine:
         exist for."""
         state_manager.on_touch(lambda conv: self.touch_conversation(conv.id))
         state_manager.on_evict(lambda conv: self.drop_conversation(conv.id))
+        #: Kept so finished turns can record their prefix handle on the
+        #: conversation (state_manager.record_prefix_handle). Never
+        #: called while holding self._mu: the state manager fires its
+        #: eviction hooks under its own lock, so the lock order is
+        #: strictly state-manager → engine.
+        self._state_manager = state_manager
 
     def touch_conversation(self, conv_id: str) -> None:
         with self._mu:
@@ -396,12 +442,52 @@ class InferenceEngine:
         with self._mu:
             self._drop_conversation_locked(conv_id)
 
-    def _drop_conversation_locked(self, conv_id: str) -> None:
+    def _drop_conversation_locked(self, conv_id: str,
+                                  invalidate: bool = True) -> None:
+        """``invalidate`` distinguishes the conversation being DELETED
+        (service eviction/delete → its content must not linger in the
+        radix tree) from merely losing its HBM pin (TTL / pool
+        pressure → the tree is exactly the fallback that lets turn N+1
+        still reuse the prefix, so it must survive)."""
+        streams = list(self._conv_evicted_tokens.pop(conv_id, None) or [])
         kv = self._conv_cache.pop(conv_id, None)
         if kv is not None:
             self.allocator.unpin(conv_id)
             self.allocator.free(kv.pages)
-        elif conv_id in self._conv_busy:
+            streams.append(kv.tokens)
+        if self._prefix_cache is not None and streams:
+            if invalidate:
+                # Conversation-delete invalidation: prune EVERY stream
+                # this conversation ever published (a pin that expired
+                # and a later no-history turn diverge into separate
+                # branches — the newest alone would leave the older
+                # branch matchable). Each prune takes the unlocked,
+                # childless tail; a prefix shared with another live
+                # stream (locked, or an interior node) survives.
+                for t in streams:
+                    self._prefix_cache.invalidate(t)
+            else:
+                # Pin merely reclaimed (TTL / pressure): remember the
+                # streams so a LATER delete still honors the contract.
+                # Never popped on re-pin — a superseding stream may
+                # diverge, and re-invalidating a live prefix is a no-op.
+                # Bounded in TOKENS (not just entries): the lists hold
+                # full written histories, and hoarding gigabytes for a
+                # delete that may never come inverts the trade — oldest
+                # entries fall off first (their tree content is likely
+                # LRU-evicted by then anyway).
+                self._conv_evicted_tokens[conv_id] = streams[-4:]
+                budget = 1_000_000
+                total = sum(len(t) for ss in self._conv_evicted_tokens.values()
+                            for t in ss)
+                while (total > budget or
+                       len(self._conv_evicted_tokens) > 4096):
+                    oldest = next(iter(self._conv_evicted_tokens))
+                    if oldest == conv_id and len(self._conv_evicted_tokens) == 1:
+                        break
+                    dropped = self._conv_evicted_tokens.pop(oldest)
+                    total -= sum(len(t) for t in dropped)
+        if kv is None and conv_id in self._conv_busy:
             # An active sequence owns the pages; don't re-cache at finish.
             self._conv_drop_pending.add(conv_id)
 
@@ -698,6 +784,13 @@ class InferenceEngine:
     def _release_sequence_pages(self, seq: _Sequence) -> None:
         """Take ``seq``'s KV pages back into the pool. The sequence will
         rebuild by re-prefilling ``written_ids`` when next admitted."""
+        if seq.prefix_match is not None:
+            # The shed pages include radix-matched shared pages: drop
+            # their in-flight node pins (the free below drops this
+            # sequence's page refs; the tree's own refs keep shared KV
+            # alive for everyone else). The rebuild re-matches.
+            self._prefix_cache.unlock(seq.prefix_match)
+            seq.prefix_match = None
         if seq.pages:
             self.allocator.free(seq.pages)
             seq.pages = []
@@ -718,6 +811,19 @@ class InferenceEngine:
             seq.rebuild = True
         seq.prefilled = False
 
+    def _unmatch(self, seq: _Sequence) -> None:
+        """Undo a radix match that could not complete admission: unlock
+        the nodes, release this sequence's page refs and reset its
+        position state so a retry recomputes (and re-matches) cleanly."""
+        self._prefix_cache.unlock(seq.prefix_match)
+        seq.prefix_match = None
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+        seq.block_table[:] = 0
+        seq.pos = 0
+        seq.cached_len = 0
+
     def _reclaim_idle_conversation(self) -> bool:
         """LRU-evict one idle pinned conversation to relieve pool
         pressure. Returns True if pages were freed."""
@@ -726,7 +832,7 @@ class InferenceEngine:
                 return False
             cid = min(self._conv_cache,
                       key=lambda c: self._conv_cache[c].last_used)
-            self._drop_conversation_locked(cid)
+            self._drop_conversation_locked(cid, invalidate=False)
         log.info("evicted conversation KV %s under pool pressure", cid)
         return True
 
@@ -761,6 +867,13 @@ class InferenceEngine:
             pages = self.allocator.alloc(n)
             if pages is not None:
                 return pages
+            if self._prefix_cache is not None and self._prefix_cache.evict_pages(
+                    n - self.allocator.available()) > 0:
+                # Cheapest shed first: zero-ref radix leaves cost no
+                # recompute for any RUNNING sequence (in-flight matches
+                # are lock-pinned and skipped; a future turn merely
+                # re-prefills what it would have reused).
+                continue
             if self._reclaim_idle_conversation():
                 continue
             if self._reclaim_pending_pages(requester):
@@ -862,6 +975,30 @@ class InferenceEngine:
                                  "prompt exceeds KV capacity")
                     return True
                 ids = ids[-keep:]
+            # Radix prefix reuse: a from-scratch prefill (first turn of a
+            # conversation, a conversation whose pinned KV was reclaimed,
+            # a rebuild, or any request sharing a system prompt) adopts
+            # the longest cached page-aligned prefix instead of
+            # re-prefilling it. The partial-block tail and at least the
+            # final token stay in ``ids`` and are prefilled normally —
+            # the continuation-prefill path the conversation cache
+            # already exercises. Matched pages are shared (ref-counted);
+            # this sequence's writes start at ``start_pos`` and land in
+            # its own fresh blocks, never in a shared page (COW by block).
+            match_seed: Optional[List[int]] = None
+            if (self._prefix_cache is not None and start_pos == 0
+                    and not seq.pages and len(ids) > 1):
+                m = self._prefix_cache.match(ids)
+                if m.nodes:
+                    n_m = len(m.pages)
+                    seq.pages = list(m.pages)
+                    seq.block_table[:n_m] = m.pages
+                    seq.prefix_match = m
+                    seq.pos = m.length
+                    seq.cached_len = m.length
+                    match_seed = ids[:m.length]
+                    ids = ids[m.length:]
+                    start_pos = m.length
             have = len(seq.pages)
             need = PageAllocator.pages_for(
                 start_pos + len(ids) + 1, self.spec.page_size) - have
@@ -873,6 +1010,12 @@ class InferenceEngine:
             if need > 0:
                 pages = self._alloc_pages(need, seq)
                 if pages is None:
+                    if match_seed is not None:
+                        # Give the matched pages back (a retried
+                        # admission recomputes ids from scratch, so
+                        # holding a partial match here would replay the
+                        # matched tokens at shifted positions).
+                        self._unmatch(seq)
                     return False
                 seq.block_table[have:have + need] = pages
                 seq.pages.extend(pages)
@@ -888,11 +1031,29 @@ class InferenceEngine:
             seq.todo_rebuild = seq.rebuild
             seq.todo_resume = resume_last
             seq.rebuild = False
-            if seq.todo_rebuild or start_pos == 0:
-                seq.written_ids = []
+            if seq.todo_rebuild or start_pos == 0 or match_seed is not None:
+                # written_ids must mirror [0, pos): seed it with the
+                # matched prefix (empty when starting truly from
+                # scratch); prefill chunks append the rest.
+                seq.written_ids = list(match_seed or [])
             if not (seq.todo_rebuild and seq.generated):
                 seq.prefill_ids = ids
                 seq.prefill_start = start_pos
+            if self._prefix_cache is not None and not seq.reuse_counted:
+                seq.reuse_counted = True
+                if seq.cached_len > 0:
+                    self.prefix_hits += 1
+                    self.cached_prefill_tokens_total += seq.cached_len
+                else:
+                    self.prefix_misses += 1
+                if self._metrics:
+                    fam = (self._metrics.prefix_cache_hits
+                           if seq.cached_len > 0
+                           else self._metrics.prefix_cache_misses)
+                    fam.labels(self.name).inc()
+                    if seq.cached_len > 0:
+                        self._metrics.cached_prefill_tokens.labels(
+                            self.name).inc(seq.cached_len)
             seq.slot = slot
             self._slots[slot] = seq        # slot held; prefilled=False
             seq.handle.marks.setdefault("admitted", time.perf_counter())
@@ -1107,7 +1268,58 @@ class InferenceEngine:
         if self._pending[0][0] > int(Priority.REALTIME):
             return 16
         step_ms = getattr(self.executor, "step_ms", None) or 4.0
-        return max(2, min(16, int(self.realtime_admission_ms / step_ms)))
+        cap = max(2, min(16, int(self.realtime_admission_ms / step_ms)))
+        if self._prefix_cache is not None:
+            # Cache-aware sizing: when the realtime waiter's context is
+            # expected mostly CACHED, its first token follows admission
+            # almost immediately (the prefill is just the tail), so the
+            # admission wait IS its TTFT — halve the chunk cap to admit
+            # it sooner. A waiter facing a big uncached prefill keeps
+            # the standard cap: tighter chunks would tax the whole
+            # batch without moving its prefill-dominated TTFT.
+            head = self._pending[0][2]
+            # Estimate the waiter's prompt TOKENS from its text length
+            # (prefill_estimate's contract) — tokenization hasn't
+            # happened yet and must not on this hot path.
+            cpt = getattr(self.tokenizer, "chars_per_token", 1.0) or 1.0
+            est_tokens = max(1, int(len(head.req.prompt) / cpt))
+            cached, new = self.prefill_estimate(
+                head.req.conversation_id, est_tokens)
+            if cached > new:
+                cap = max(2, cap // 2)
+        return cap
+
+    def prefill_estimate(self, conversation_id: str,
+                         prompt_tokens: int) -> "tuple[int, int]":
+        """(expected_cached, expected_new) prefill tokens for an
+        arriving request — the cache-aware admission seam (used by the
+        realtime chunk cap above and by
+        ResourceScheduler.set_prefill_estimator). A conversation with
+        pinned KV reports its resident length; with the pin reclaimed,
+        the conversation service's recorded prefix handle stands in —
+        the radix tree usually still holds the committed full blocks
+        (optimistic: LRU may have evicted them, but this is a sizing
+        heuristic, not an allocation). Otherwise the estimate is
+        conservatively all-new (tree matches need the token ids, which
+        don't exist before tokenization)."""
+        cached = 0
+        if conversation_id:
+            with self._mu:
+                kv = self._conv_cache.get(conversation_id)
+                if kv is not None:
+                    cached = kv.length
+            if (cached == 0 and self._state_manager is not None
+                    and self._prefix_cache is not None):
+                # Outside self._mu: the state manager's lock sits ABOVE
+                # the engine's in the ordering.
+                try:
+                    h = self._state_manager.prefix_handle(conversation_id)
+                except Exception:  # noqa: BLE001 — estimate, not a gate
+                    h = None
+                if h:
+                    ps = self.spec.page_size
+                    cached = (int(h.get("length", 0)) // ps) * ps
+        return cached, max(0, int(prompt_tokens))
 
     def _has_scheduling_work(self) -> bool:
         """Anything that requires host-side scheduling before the next
@@ -1487,6 +1699,16 @@ class InferenceEngine:
             self._slots[seq.slot] = None
             seq.slot = None
         conv = seq.req.conversation_id
+        # Publish the finished sequence's full-block KV prefix into the
+        # radix tree (tree retains its own page refs; the sequence's
+        # refs are released below exactly as before) — this is how a
+        # later turn, or an unrelated request sharing a system prompt,
+        # finds the pages. Skipped on a written_ids/pos mismatch: a
+        # mis-keyed block would serve wrong KV to whoever matches it.
+        publish = (self._prefix_cache is not None
+                   and reason in ("eos", "length")
+                   and len(seq.written_ids) == seq.pos)
+        handle_rec = None
         if conv and reason in ("eos", "length"):
             # Trim pages past the written length before pinning: decode
             # budgets allocate ahead (and a joined row that finished at
@@ -1501,12 +1723,29 @@ class InferenceEngine:
             with self._mu:
                 if conv in self._conv_drop_pending:
                     self._conv_drop_pending.discard(conv)
+                    if seq.prefix_match is not None:
+                        # Unlock BEFORE invalidating: this sequence's
+                        # own match pins the deepest path nodes, and
+                        # invalidate() stops at the first locked node —
+                        # pruning would silently no-op against our own
+                        # lock. The sequence is finishing; its pages
+                        # are freed right here.
+                        self._prefix_cache.unlock(seq.prefix_match)
+                        seq.prefix_match = None
                     self.allocator.free(seq.pages)
+                    if self._prefix_cache is not None:
+                        # Deleted mid-turn: earlier turns' published
+                        # blocks are prefixes of this written stream —
+                        # prune what's exclusively this conversation's.
+                        self._prefix_cache.invalidate(seq.written_ids)
                 else:
                     if len(seq.written_ids) != seq.pos:
                         log.warning(
                             "written_ids/pos mismatch for %s: %d vs %d",
                             seq.req.id, len(seq.written_ids), seq.pos)
+                    if publish:
+                        self._prefix_cache.insert(seq.written_ids,
+                                                  list(seq.pages))
                     self._conv_cache[conv] = _ConvKV(
                         pages=list(seq.pages),
                         block_table=seq.block_table.copy(),
@@ -1516,10 +1755,26 @@ class InferenceEngine:
                         pending=(seq.last_token if reason == "length"
                                  else None))
                     self.allocator.pin(conv, seq.pages)
+                    if self._prefix_cache is not None:
+                        handle_rec = {"length": seq.pos,
+                                      "pages": len(seq.pages),
+                                      "updated_at": self._clock.now()}
             seq.pages = []
+        elif publish and seq.pages:
+            self._prefix_cache.insert(seq.written_ids, list(seq.pages))
+        if handle_rec is not None and self._state_manager is not None:
+            # Outside self._mu: the state manager's lock is ABOVE the
+            # engine's in the ordering (its eviction hooks call back in).
+            try:
+                self._state_manager.record_prefix_handle(conv, handle_rec)
+            except Exception:  # noqa: BLE001 — accounting, not a gate
+                log.exception("prefix-handle record failed for %s", conv)
         self._finish(seq, reason)
 
     def _finish(self, seq: _Sequence, reason: str, error: str = "") -> None:
+        if seq.prefix_match is not None:
+            self._prefix_cache.unlock(seq.prefix_match)
+            seq.prefix_match = None
         if seq.pages:
             self.allocator.free(seq.pages)
             seq.pages = []
@@ -1546,7 +1801,10 @@ class InferenceEngine:
             stale = [cid for cid, kv in self._conv_cache.items()
                      if now - kv.last_used > self.kv_pin_ttl]
             for cid in stale:
-                self._drop_conversation_locked(cid)
+                # Pin TTL only ends HBM *residency priority* — the radix
+                # tree keeps the prefix for turn N+1 (evicted there only
+                # by LRU/pressure), so no invalidate.
+                self._drop_conversation_locked(cid, invalidate=False)
 
     def _set_gauges(self) -> None:
         if not self._metrics:
@@ -1557,14 +1815,23 @@ class InferenceEngine:
             len(self._conv_cache))
         self._metrics.batch_occupancy.labels(self.name).set(
             sum(1 for s in self._slots if s is not None))
+        if self._prefix_cache is not None:
+            self._metrics.prefix_cache_pages.labels(self.name).set(
+                self._prefix_cache.pages)
 
     # -- stats ---------------------------------------------------------------
+
+    def pending_count(self) -> int:
+        """Cheap queue-depth probe (one lock, two lens) for admission
+        gates that must not pay the full get_stats() build."""
+        with self._mu:
+            return len(self._pending) + len(self._inbox)
 
     def get_stats(self) -> Dict:
         with self._mu:
             pending = len(self._pending) + len(self._inbox)
             cached = len(self._conv_cache)
-        return {
+        out = {
             "name": self.name,
             "slots": self.spec.batch_size,
             "active": sum(1 for s in self._slots if s is not None),
@@ -1575,3 +1842,14 @@ class InferenceEngine:
             "cached_conversations": cached,
             "profile": self._prof.summary(),
         }
+        if self._prefix_cache is not None:
+            pc = self._prefix_cache.get_stats()
+            total = self.prefix_hits + self.prefix_misses
+            pc["admission_hits"] = self.prefix_hits
+            pc["admission_misses"] = self.prefix_misses
+            pc["admission_hit_rate"] = (
+                round(self.prefix_hits / total, 4) if total else 0.0)
+            pc["cached_prefill_tokens"] = self.cached_prefill_tokens_total
+            pc["shared_pages"] = self.allocator.shared_pages()
+            out["prefix_cache"] = pc
+        return out

@@ -664,22 +664,51 @@ class JaxExecutor:
             self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
                               np.ones(spec.batch_size, np.int32))
             # Per-step cost estimate for the engine's tier-aware
-            # admission cap: time a 1-step and a K-step chunk (both pay
-            # one host round-trip, so the difference isolates compute).
-            # Warmup writes land on reserved page 0 only.
+            # admission cap: time (1-step, K-step) chunk PAIRS — both
+            # pay one host round-trip, so the difference isolates
+            # compute. One pair is fragile: a randomly-initialized
+            # model can sample EOS, latching rows so the K-step chunk
+            # exits early (overestimating per-step speed), and one-off
+            # host/tunnel stalls corrupt either timing. So: several
+            # pairs, each K-step chunk's EFFECTIVE step count read from
+            # its own output (first-EOS position per row — the
+            # while-loop runs until the LAST live row is done), median
+            # across pairs, then a sanity clamp before this number sets
+            # the realtime chunk cap. Warmup writes land on reserved
+            # page 0 only.
             import time as _time
-            t0 = _time.perf_counter()
-            self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
-                              np.ones(spec.batch_size, np.int32))
-            t1 = _time.perf_counter()
-            self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
-                              np.full(spec.batch_size, self.chunk_size,
-                                      np.int32))
-            t2 = _time.perf_counter()
-            self.step_ms = max(
-                0.05, ((t2 - t1) - (t1 - t0)) / max(1, self.chunk_size - 1)
-                * 1e3)
-            log.info("warmup measured decode step ~%.2f ms", self.step_ms)
+
+            K = self.chunk_size
+            ones = np.ones(spec.batch_size, np.int32)
+            full = np.full(spec.batch_size, K, np.int32)
+            samples = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                self.decode_chunk(zeros_b, zeros_b, zbt, ztemp, ones)
+                t1 = _time.perf_counter()
+                out = self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
+                                        full)
+                t2 = _time.perf_counter()
+                # Effective steps = the longest row before EOS latched
+                # (the device loop keeps iterating while ANY row lives).
+                live = out != spec.eos_id           # (B, K)
+                eff = int(live.any(axis=0).sum()) or 1
+                if eff > 1:
+                    samples.append(((t2 - t1) - (t1 - t0)) / (eff - 1)
+                                   * 1e3)
+            if samples:
+                samples.sort()
+                est = samples[len(samples) // 2]
+                # Clamp: a negative/zero pair (stall hit the 1-step
+                # timing) or an absurd outlier must not set the cap.
+                self.step_ms = float(min(250.0, max(0.05, est)))
+                log.info("warmup measured decode step ~%.2f ms "
+                         "(median of %d pairs)", self.step_ms,
+                         len(samples))
+            else:
+                self.step_ms = None
+                log.warning("decode step timing unusable (EOS latched "
+                            "every chunk); admission cap falls back")
 
     # -- Executor API --------------------------------------------------------
 

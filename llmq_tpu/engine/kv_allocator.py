@@ -10,6 +10,12 @@ The device pool is ``(L, P, page_size, H_kv, D)`` (models/llama.py
   (cache-warm) pages are reused first.
 - all-or-nothing allocation: a request that cannot get every page it
   needs gets none, so a half-admitted sequence never deadlocks the pool.
+- **ref-counted sharing** (prefix cache): a page handed out by
+  :meth:`alloc` starts at refcount 1; :meth:`retain` adds holders (the
+  radix prefix cache sharing one physical page across sequences) and
+  :meth:`free` drops one holder — the page returns to the free list only
+  when its last holder lets go. Code that never calls ``retain`` sees
+  exactly the old exclusive-ownership semantics.
 
 The conversation KV pinning of BASELINE config #3 is accounted here via
 named pins: the engine pins a conversation's pages while its KV stays
@@ -32,28 +38,58 @@ class PageAllocator:
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: List[int] = list(range(num_pages - 1, 0, -1))  # 1..P-1
+        self._refs: Dict[int, int] = {}        # page id → holder count
         self._pins: Dict[str, List[int]] = {}
         self._mu = threading.Lock()
 
     # -- allocation ----------------------------------------------------------
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Allocate ``n`` pages, or None if the pool can't satisfy all of
-        them (all-or-nothing)."""
+        """Allocate ``n`` pages (each at refcount 1), or None if the pool
+        can't satisfy all of them (all-or-nothing)."""
         if n <= 0:
             return []
         with self._mu:
             if len(self._free) < n:
                 return None
             pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
         return pages
 
+    def retain(self, pages: List[int]) -> None:
+        """Add one holder to each page — block-granular sharing: the
+        prefix cache retains a page per tree node, and every sequence
+        whose block table references a shared page retains it for the
+        duration of the match."""
+        with self._mu:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(f"retain of unallocated page {p}")
+                self._refs[p] += 1
+
     def free(self, pages: List[int]) -> None:
+        """Drop one holder per page; pages whose last holder left return
+        to the free list. (Copy-on-write discipline lives above: holders
+        must never WRITE a page whose refcount exceeds their own share —
+        they allocate a fresh page instead.)"""
         with self._mu:
             for p in pages:
                 if p <= 0 or p >= self.num_pages:
                     raise ValueError(f"bad page id {p}")
-                self._free.append(p)
+                refs = self._refs.get(p)
+                if refs is None:
+                    raise ValueError(f"double free of page {p}")
+                if refs > 1:
+                    self._refs[p] = refs - 1
+                else:
+                    del self._refs[p]
+                    self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        """Current holder count (0 = free)."""
+        with self._mu:
+            return self._refs.get(page, 0)
 
     # -- conversation pins (BASELINE config #3) ------------------------------
 
@@ -87,6 +123,11 @@ class PageAllocator:
 
     def used(self) -> int:
         return self.total - self.available()
+
+    def shared_pages(self) -> int:
+        """Pages with more than one holder (prefix-cache sharing)."""
+        with self._mu:
+            return sum(1 for r in self._refs.values() if r > 1)
 
     def pinned_pages(self) -> int:
         with self._mu:

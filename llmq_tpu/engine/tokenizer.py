@@ -36,6 +36,9 @@ class ByteTokenizer:
     eos_id = 2
     _OFFSET = 3
     vocab_size = 256 + _OFFSET
+    #: Rough chars-per-token for token-count estimates from raw text
+    #: (admission heuristics that must not pay an encode): bytes ≈ 1:1.
+    chars_per_token = 1.0
 
     def encode(self, text: str) -> List[int]:
         return [b + self._OFFSET for b in text.encode("utf-8")]
@@ -62,6 +65,9 @@ class HFTokenizer:
         self.bos_id = _id(self._tok.bos_token_id, 1)
         self.eos_id = _id(self._tok.eos_token_id, 2)
         self.vocab_size = len(self._tok)
+        #: Subword vocabularies average ~4 chars/token on English text —
+        #: good enough for admission heuristics (never for KV sizing).
+        self.chars_per_token = 4.0
 
     def encode(self, text: str) -> List[int]:
         return self._tok.encode(text, add_special_tokens=False)

@@ -77,12 +77,20 @@ class HttpEngineClient:
                 timeout = min(self.timeout, rem)
         payload = msg.to_dict()
         payload["timeout"] = timeout
+        # Socket timeout gets HEADROOM over the server's generation
+        # budget: the server enforces ``timeout`` itself and answers a
+        # deadline miss with a 504 we can classify. With socket timeout
+        # == server budget, the socket usually fires FIRST and the miss
+        # surfaces as URLError("timed out") → the generic
+        # "unreachable" RuntimeError — penalized by the LB as an
+        # endpoint error even though the endpoint was healthy.
+        sock_timeout = timeout + max(2.0, 0.1 * timeout)
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/generate",
             data=json.dumps(payload).encode("utf-8"),
             headers={"Content-Type": "application/json"}, method="POST")
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with urllib.request.urlopen(req, timeout=sock_timeout) as resp:
                 data = json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
             detail = ""
@@ -98,6 +106,19 @@ class HttpEngineClient:
                 f"remote engine {self.base_url} failed "
                 f"({e.code}): {detail}") from None
         except (urllib.error.URLError, OSError) as e:
+            # Distinguish "took too long" from "not there". A READ-phase
+            # socket timeout (raised raw as TimeoutError from resp.read)
+            # means the endpoint accepted the request and overran the
+            # budget+headroom — a deadline miss (worker retry/timeout
+            # path). A CONNECT-phase timeout arrives WRAPPED in URLError
+            # (urllib wraps all connect errors) and means the host is
+            # black-holed — that stays "unreachable" so the LB penalizes
+            # the endpoint instead of re-burning the full budget on it.
+            if isinstance(e, TimeoutError) and not isinstance(
+                    e, urllib.error.URLError):
+                raise TimeoutError(
+                    f"remote engine {self.base_url} exceeded its "
+                    f"{timeout:.0f}s budget (+headroom)") from None
             raise RuntimeError(
                 f"remote engine {self.base_url} unreachable: {e}") from None
         msg.response = data.get("response", "")

@@ -87,6 +87,24 @@ class QueueMetrics:
         self.preemptions = Counter(
             f"{ns}_preemptions_total", "Step-boundary preemptions",
             ["engine", "priority"], registry=registry)
+        # Prefix cache (prefixcache/radix.py, docs/prefix_cache.md):
+        self.prefix_cache_hits = Counter(
+            f"{ns}_prefix_cache_hits_total",
+            "Admissions that adopted a cached KV prefix", ["engine"],
+            registry=registry)
+        self.prefix_cache_misses = Counter(
+            f"{ns}_prefix_cache_misses_total",
+            "Admissions that found no cached prefix", ["engine"],
+            registry=registry)
+        self.cached_prefill_tokens = Counter(
+            f"{ns}_cached_prefill_tokens_total",
+            "Prompt tokens whose prefill was skipped (KV served from "
+            "the prefix cache or a pinned conversation)", ["engine"],
+            registry=registry)
+        self.prefix_cache_pages = Gauge(
+            f"{ns}_prefix_cache_pages",
+            "KV pages currently held by the radix prefix cache",
+            ["engine"], registry=registry)
 
 
 def get_metrics() -> QueueMetrics:

@@ -1,0 +1,17 @@
+"""Radix-tree prefix KV cache (block-granular sharing over the paged
+allocator). See :mod:`llmq_tpu.prefixcache.radix` and
+docs/prefix_cache.md."""
+
+from llmq_tpu.prefixcache.radix import (
+    EVICTION_POLICIES,
+    PrefixCache,
+    PrefixMatch,
+    RadixNode,
+)
+
+__all__ = [
+    "EVICTION_POLICIES",
+    "PrefixCache",
+    "PrefixMatch",
+    "RadixNode",
+]

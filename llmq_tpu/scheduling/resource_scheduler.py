@@ -121,6 +121,10 @@ class ResourceAllocation:
     token: str
     allocated_at: float
     expires_at: float             # 0 = no expiry
+    #: What was actually charged to the resource — differs from
+    #: ``request.amounts`` when the cache-aware prefill estimator
+    #: discounted the TOKENS amount; release must refund exactly this.
+    charged: Optional[Dict[ResourceType, float]] = None
 
     def to_dict(self) -> Dict:
         return {
@@ -159,6 +163,51 @@ class ResourceScheduler:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._on_allocate: List[Callable[[ResourceAllocation], None]] = []
+        #: Cache-aware admission seam (docs/prefix_cache.md): maps a
+        #: request's metadata to (expected_cached, expected_new) prefill
+        #: tokens — typically InferenceEngine.prefill_estimate bound by
+        #: the serving entrypoint. See :meth:`set_prefill_estimator`.
+        self._prefill_estimator: Optional[
+            Callable[[Dict], "tuple[int, int]"]] = None
+
+    # -- cache-aware admission (prefix cache) --------------------------------
+
+    def set_prefill_estimator(
+            self, fn: Optional[Callable[[Dict], "tuple[int, int]"]]) -> None:
+        """Register ``fn(metadata) -> (expected_cached, expected_new)``.
+
+        When a :class:`ResourceRequest` sizes itself in TOKENS, the raw
+        amount assumes the whole context must be prefilled; with a
+        prefix cache serving part of it from resident KV, that
+        overstates the work and under-admits. ``_try_allocate`` charges
+        only the expected NEW tokens (never more than requested, never
+        below 1) so realtime chunk sizing reflects actual compute."""
+        with self._mu:
+            self._prefill_estimator = fn
+
+    def _effective_amounts(
+            self, request: ResourceRequest) -> Dict[ResourceType, float]:
+        amounts = dict(request.amounts)
+        tok = amounts.get(ResourceType.TOKENS)
+        if tok is None or self._prefill_estimator is None:
+            return amounts
+        try:
+            cached, new = self._prefill_estimator(request.metadata)
+        except Exception:  # noqa: BLE001 — estimator is advisory
+            log.exception("prefill estimator failed; charging raw tokens")
+            return amounts
+        if cached <= 0 or new <= 0:
+            # No reuse expected, or no usable estimate (e.g. the request
+            # metadata carried no prompt size → new == 0): charge the
+            # raw amount. Discounting on a zero-information estimate
+            # would collapse the charge to ~nothing and disable token
+            # admission control entirely.
+            return amounts
+        total = cached + new
+        # Charge the uncached share of the REQUESTED amount (the caller
+        # knows its own token count better than the estimator does).
+        amounts[ResourceType.TOKENS] = max(1.0, tok * (new / total))
+        return amounts
 
     # -- registry (:138-162) -------------------------------------------------
 
@@ -254,18 +303,19 @@ class ResourceScheduler:
 
     def _try_allocate(self, request: ResourceRequest) -> Optional[ResourceAllocation]:
         with self._mu:
+            amounts = self._effective_amounts(request)
             candidates = [
                 r for r in self._resources.values()
                 if r.status == ResourceStatus.ONLINE
                 and r.model_type == request.model_type
                 and request.capabilities.issubset(r.capabilities)
                 and all(r.available(t) >= amt
-                        for t, amt in request.amounts.items())
+                        for t, amt in amounts.items())
             ]
             if not candidates:
                 return None
             chosen = min(candidates, key=lambda r: r.load)
-            for t, amt in request.amounts.items():
+            for t, amt in amounts.items():
                 chosen.used[t] = chosen.used.get(t, 0.0) + amt
             now = self._clock.now()
             # request.timeout bounds PENDING wait only; the allocation's
@@ -284,6 +334,7 @@ class ResourceScheduler:
                 token=str(uuid.uuid4()),
                 allocated_at=now,
                 expires_at=now + timeout if timeout > 0 else 0.0,
+                charged=amounts,
             )
             self._allocations[alloc.id] = alloc
             callbacks = list(self._on_allocate)
@@ -313,7 +364,7 @@ class ResourceScheduler:
         self._allocations.pop(alloc.id, None)
         r = self._resources.get(alloc.resource_id)
         if r is not None:
-            for t, amt in alloc.request.amounts.items():
+            for t, amt in (alloc.charged or alloc.request.amounts).items():
                 r.used[t] = max(0.0, r.used.get(t, 0.0) - amt)
 
     def get_allocation(self, allocation_id: str) -> Optional[ResourceAllocation]:

@@ -20,7 +20,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS path
+    # above still applies because no backend has initialised yet (the
+    # site hook imports jax but never touches devices).
+    pass
 
 import pytest  # noqa: E402
 

@@ -467,6 +467,94 @@ class TestStreamingAndGenerate:
         finally:
             server.engine = engine
 
+    def test_stream_garbage_value_400(self, stack):
+        client, _ = stack
+        status, body, _ = client.post(
+            "/api/v1/messages",
+            {"content": "x", "user_id": "u", "stream": "yes please"})
+        assert status == 400
+        assert "stream" in body["error"]
+
+    def test_stream_string_booleans_accepted(self, stack):
+        client, _ = stack
+        # "false" must NOT stream (and must not 500): normal 202 submit.
+        status, body, _ = client.post(
+            "/api/v1/messages",
+            {"content": "x", "user_id": "u", "stream": "false"})
+        assert status == 202
+        # null means "not set" (optional-field serializers): 202 too.
+        status, body, _ = client.post(
+            "/api/v1/messages",
+            {"content": "x", "user_id": "u", "stream": None})
+        assert status == 202
+
+    def test_stream_non_numeric_timeout_400(self, stack):
+        client, _ = stack
+        status, body, _ = client.post(
+            "/api/v1/messages",
+            {"content": "x", "user_id": "u", "stream": True,
+             "timeout": "soon"})
+        assert status == 400
+        assert "timeout" in body["error"]
+
+    def test_stream_concurrency_cap_429(self, stack):
+        client, server = stack
+        server.config.server.max_concurrent_streams = 1
+        try:
+            # Occupy the only slot with a fake in-flight stream.
+            server._acquire_stream_slot()
+            status, body, _ = client.post(
+                "/api/v1/messages",
+                {"content": "x", "user_id": "u", "stream": True})
+            assert status == 429
+        finally:
+            server._release_stream_slot()
+            server.config.server.max_concurrent_streams = 32
+        # Slot released → streaming works again.
+        events = self._sse(client.port, {
+            "content": "ok now", "user_id": "u", "stream": True})
+        assert events[-1][0] == "done"
+        assert server._active_streams == 0           # fully released
+
+    def test_stream_slot_released_without_iteration(self, stack):
+        """A client that disconnects before the response headers go out
+        means the event generator is never started — its finally never
+        runs. The handler's on_close hook must still release the slot
+        (regression: 32 such disconnects used to 429 streaming forever)."""
+        client, server = stack
+        status, payload, _ = server.dispatch(
+            "POST", "/api/v1/messages",
+            json.dumps({"content": "never read", "user_id": "u",
+                        "stream": True}).encode())
+        assert status == 200
+        assert server._active_streams == 1
+        payload.on_close()                    # handler finally, no iteration
+        assert server._active_streams == 0
+        payload.on_close()                    # idempotent
+        assert server._active_streams == 0
+        payload.events.close()
+        # The orphaned engine request was cancelled and the stored
+        # record moved to a terminal state (not immortal PROCESSING).
+        rec = next(m for m in server.store.list(limit=50)
+                   if m.content == "never read")
+        assert rec.status.value == "failed"
+
+    def test_stream_backlog_shed_503(self, stack):
+        client, server = stack
+        server.config.server.stream_pending_limit = 1
+        try:
+            # Simulate a deep engine backlog (stubbing stats is the
+            # deterministic stand-in for actually flooding the queue).
+            import unittest.mock as mock
+            with mock.patch.object(server.engine, "pending_count",
+                                   return_value=5):
+                status, body, _ = client.post(
+                    "/api/v1/messages",
+                    {"content": "x", "user_id": "u", "stream": True})
+            assert status == 503
+        finally:
+            server.config.server.stream_pending_limit = 256
+
     def test_generate_sync_rpc(self, stack):
         client, _ = stack
         status, body, _ = client.post(
