@@ -26,6 +26,10 @@ LLMQ_BENCH_BATCH, LLMQ_BENCH_DECODE_STEPS, LLMQ_BENCH_SEQ,
 LLMQ_BENCH_CHUNK, LLMQ_BENCH_PAGE, LLMQ_BENCH_SLA_MODEL,
 LLMQ_BENCH_SLA_QUANT, LLMQ_BENCH_TPU_POISSON_RATES,
 LLMQ_BENCH_TPU_POISSON_SECS, LLMQ_BENCH_TPU_SLOTS,
+LLMQ_BENCH_TPU_REPEATS (repeats per rate point; median + spread
+recorded), LLMQ_BENCH_SLA_PAGE / LLMQ_BENCH_SLA_PAGE_8B /
+LLMQ_BENCH_SLA_KV_QUANT_8B (SLA-sweep serving geometry; the 8B path
+defaults to the tuned 128-token pages + int8 KV),
 LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU,
 LLMQ_BENCH_PREFIX_CACHE (=0 disables the radix prefix KV cache in the
 SLA sweeps for A/B comparison).
@@ -227,7 +231,6 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
             break
         time.sleep(0.05)
     factory.stop_all()
-    engine.stop()
 
     total_done = sum(len(v) for v in lat.values())
     elapsed = time.perf_counter() - t_start
@@ -235,6 +238,14 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
                  "achieved_rate": round(total_done / elapsed, 1),
                  "sent": n_sent, "completed": total_done}
     tier_report(lat, out, "poisson")
+    # Wire-measured first-token latency against the SAME live engine
+    # (real HTTP serve path): present even on accelerator-less runs.
+    try:
+        out["first_token_wire_ms"] = bench_first_token_wire(engine)
+    except Exception as e:  # noqa: BLE001
+        log(f"[wire] echo wire measurement failed: "
+            f"{type(e).__name__}: {e}")
+    engine.stop()
     return out
 
 
@@ -436,6 +447,77 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
     }
 
 
+# -- wire-measured first-token latency (SSE client on the serve path) ---------
+
+def bench_first_token_wire(engine, n_per_tier: int = 6) -> Dict:
+    """Submit→first-SSE-token-byte per tier, measured by a real HTTP
+    client against the real serve path (ApiServer streaming route) —
+    what a user's terminal actually waits, including HTTP parse, queue
+    bypass, engine admission AND the server's SSE framing/flush, next
+    to the engine-mark ``first_token_ms`` the decomp reports.
+
+    ``first_byte_ms`` (the SSE ``start`` event, written at accept) is
+    reported alongside so transport overhead is separable from model
+    time."""
+    import http.client
+
+    from llmq_tpu.api.server import ApiServer
+    from llmq_tpu.core.config import default_config as _dc
+
+    api = ApiServer(_dc(), engine=engine)
+    port = api.start(host="127.0.0.1", port=0)
+    out: Dict = {}
+    try:
+        for prio in TIERS:
+            tok_lat: List[float] = []
+            byte_lat: List[float] = []
+            for i in range(n_per_tier):
+                body = json.dumps({
+                    "content": f"wire probe {prio.tier_name} {i}",
+                    "user_id": "bench", "priority": int(prio),
+                    "stream": True, "timeout": 30,
+                }).encode()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                try:
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/api/v1/messages", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    first_byte = None
+                    first_tok = None
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            break
+                        if first_byte is None:
+                            first_byte = time.perf_counter() - t0
+                        if (first_tok is None
+                                and line.startswith(b"data:")
+                                and b'"token"' in line):
+                            first_tok = time.perf_counter() - t0
+                            # Token seen; drain the rest without timing.
+                    if first_byte is not None:
+                        byte_lat.append(first_byte)
+                    if first_tok is not None:
+                        tok_lat.append(first_tok)
+                finally:
+                    conn.close()
+            out[prio.tier_name] = {
+                "n": len(tok_lat),
+                "p50_ms": round(pctl(tok_lat, 0.50) * 1e3, 1),
+                "p99_ms": round(pctl(tok_lat, 0.99) * 1e3, 1),
+                "first_byte_p50_ms": round(pctl(byte_lat, 0.50) * 1e3, 1),
+            }
+            log(f"[wire] {prio.tier_name:9s} first_token_wire "
+                f"p50={out[prio.tier_name]['p50_ms']:.1f}ms "
+                f"p99={out[prio.tier_name]['p99_ms']:.1f}ms")
+    finally:
+        api.stop()
+    return out
+
+
 # -- 4. 4-tier Poisson + offered-load sweep on the REAL model (BASELINE #4) ---
 
 def _decomp(handles: List, tier: Optional[str] = None) -> Dict:
@@ -487,7 +569,9 @@ def _decomp(handles: List, tier: Optional[str] = None) -> Dict:
 
 def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                       quant: str = "", min_realtime_n: int = 50,
-                      chunk: int = 32) -> Optional[Dict]:
+                      chunk: int = 32, page_size: int = 16,
+                      kv_quant: str = "",
+                      repeats: int = 1) -> Optional[Dict]:
     """Open-loop Poisson arrivals into the jax engine on the real chip,
     swept over offered rates: per-tier end-to-end latency with strict
     priority admission, step-boundary preemption and pipelined decode
@@ -498,7 +582,20 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     Each point runs long enough for ≥``min_realtime_n`` realtime
     completions (the gated percentile is over n ≥ 50, not n = 4), and
     attaches the per-request latency decomposition so the number is
-    explainable, not just recorded."""
+    explainable, not just recorded.
+
+    Statistics hardening (BENCH_r05's non-monotonic first point):
+    ``repeats`` > 1 re-runs each rate point and records the MEDIAN
+    point (by realtime p99) plus the spread across repeats; every
+    point carries the engine's detected device/tunnel stalls
+    (``stall_events``/``stall_ms_total`` deltas) so an outlier p99 is
+    attributable in the artifact itself.
+
+    ``page_size``/``kv_quant`` select the serving geometry: the 8B SLA
+    path runs 128-token pages + int8 KV so the fused int8-KV decode
+    kernel (ops/attention.py's 128-alignment gate) is what the curve
+    measures — bench.py's tuned-decode section and the SLA server no
+    longer disagree about the kernel."""
     import jax
 
     if jax.default_backend() == "cpu" and not os.environ.get(
@@ -506,6 +603,8 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         log("[poisson-tpu] no accelerator; skipping")
         return None
     _enable_bench_cache()
+
+    import jax.numpy as jnp
 
     from llmq_tpu.engine.engine import GenRequest, InferenceEngine
     from llmq_tpu.engine.executor import JaxExecutor
@@ -515,17 +614,27 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
 
     rtt_ms = _measure_rtt()
     tok = ByteTokenizer()
-    cfg = get_config(model_name, max_seq_len=512)
+    max_seq = 512
+    cfg = get_config(model_name, max_seq_len=max_seq)
     if quant == "int8":
         params = init_params_quantized(jax.random.PRNGKey(0), cfg)
     else:
         params = init_params(jax.random.PRNGKey(0), cfg)
     slots = int(os.environ.get("LLMQ_BENCH_TPU_SLOTS", "16"))
-    ex = JaxExecutor(cfg, params, batch_size=slots, page_size=16,
-                     num_pages=slots * 32 + 1, chunk_size=chunk,
-                     prefill_buckets=[64], eos_id=tok.eos_id)
+    pages_per_seq = max(1, max_seq // page_size)
+    # 2x headroom over the worst-case live footprint: the radix prefix
+    # cache holds finished prefixes in the SAME pool, and a pool sized
+    # exactly to the live set evicts every cached prefix immediately.
+    num_pages = slots * pages_per_seq * 2 + 1
+    ex = JaxExecutor(cfg, params, batch_size=slots, page_size=page_size,
+                     num_pages=num_pages, chunk_size=chunk,
+                     prefill_buckets=[64],
+                     cache_dtype=(jnp.int8 if kv_quant == "int8"
+                                  else None),
+                     eos_id=tok.eos_id)
     log(f"[poisson-tpu] warmup {cfg.name} {quant or 'bf16'} "
-        f"({slots} slots) ...")
+        f"(kv={kv_quant or 'bf16'}, page={page_size}, "
+        f"{num_pages} pages, {slots} slots) ...")
     t0 = time.perf_counter()
     ex.warmup()
     warmup_s = time.perf_counter() - t0
@@ -546,12 +655,106 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
 
     # Discarded warm burst: the first requests after a fresh executor
     # (or a preceding bench section's HBM churn) pay one-time costs that
-    # would otherwise pollute the first swept rate point.
-    warm = [engine.submit(GenRequest(id=f"warm{i}", prompt="warm up",
-                                     max_new_tokens=24))
-            for i in range(8)]
+    # would otherwise pollute the first swept rate point. 16 requests
+    # across ALL tiers (each tier's admission path has its own first-use
+    # cost), then a short discarded Poisson phase at the highest swept
+    # rate so steady-state batching/preemption behavior is reached
+    # BEFORE the first measured point (BENCH_r05's 1019 ms @1 req/s vs
+    # 572 ms @2 was a cold first point).
+    wrng = random.Random(3)
+    warm = [engine.submit(GenRequest(
+                id=f"warm{i}", prompt=f"warm up {i % 8}",
+                priority=sample_tier(wrng, TPU_TIER_MIX),
+                max_new_tokens=24))
+            for i in range(16)]
     for h in warm:
         h.wait(60.0)
+
+    def run_phase(rate: float, dur: float,
+                  collect: bool = True) -> Optional[Dict]:
+        """One open-loop Poisson phase at ``rate`` for ``dur`` seconds;
+        returns the measured point, or None when ``collect`` is False
+        (discarded warm phase)."""
+        rng = random.Random(7)
+        handles = []
+        t_start = time.perf_counter()
+        next_arrival = t_start
+        n_sent = 0
+        stalls0 = (engine.stall_events, engine.stall_ms_total)
+        pc0 = (engine.prefix_hits, engine.prefix_misses,
+               engine.cached_prefill_tokens_total)
+        while time.perf_counter() - t_start < dur:
+            now = time.perf_counter()
+            if now < next_arrival:
+                time.sleep(min(0.002, next_arrival - now))
+                continue
+            next_arrival += rng.expovariate(rate)
+            h = engine.submit(GenRequest(
+                id=f"pt{rate}-{n_sent}",
+                prompt=f"load test request {n_sent % 50}",
+                priority=sample_tier(rng, TPU_TIER_MIX),
+                max_new_tokens=24))
+            handles.append(h)
+            n_sent += 1
+        # One SHARED drain deadline: a wedged engine must bound the
+        # bench, not stall it per-handle.
+        deadline = time.perf_counter() + 90.0
+        for h in handles:
+            if not h.wait(max(0.0, deadline - time.perf_counter())):
+                break
+        # Quiesce between phases: cancel any backlog so the next phase
+        # measures ITS offered load, not a saturated predecessor's
+        # leftovers.
+        leftovers = 0
+        for h in handles:
+            if not h.done:
+                h.cancel()
+                leftovers += 1
+        if leftovers:
+            quiesce = time.perf_counter() + 30.0
+            while time.perf_counter() < quiesce:
+                s = engine.get_stats()
+                if s["pending"] == 0 and s["active"] == 0:
+                    break
+                time.sleep(0.1)
+        if not collect:
+            return None
+        lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
+        completed = 0
+        for h in handles:
+            if h.done and h.result.finish_reason in ("eos", "length"):
+                completed += 1
+                lat[h.request.priority.tier_name].append(h.latency)
+        point: Dict = {"offered_rate": rate, "duration_s": round(dur, 0),
+                       "sent": n_sent, "completed": completed,
+                       "cancelled": leftovers}
+        tier_report(lat, point, f"poisson-tpu@{rate:g}")
+        point["decomp"] = _decomp(handles)
+        point["decomp_realtime"] = _decomp(handles, "realtime")
+        # Detected device/tunnel stalls DURING this phase (engine
+        # counter deltas): a poisoned p99 is attributable in the
+        # artifact, not just in a stderr warning.
+        point["stall_events"] = engine.stall_events - stalls0[0]
+        point["stall_ms_total"] = round(
+            engine.stall_ms_total - stalls0[1], 1)
+        if pc is not None:
+            d_h = engine.prefix_hits - pc0[0]
+            d_m = engine.prefix_misses - pc0[1]
+            point["prefix_cache_hit_rate"] = round(
+                d_h / max(1, d_h + d_m), 4)
+            point["cached_prefill_tokens"] = (
+                engine.cached_prefill_tokens_total - pc0[2])
+            log(f"[poisson-tpu@{rate:g}] prefix cache: "
+                f"hit_rate={point['prefix_cache_hit_rate']:.2f} "
+                f"cached_tokens={point['cached_prefill_tokens']}")
+        # The tunnel-free projection: the measured critical path carries
+        # ~2 host↔device round-trips (prefill-sample fetch + chunk
+        # fetch — see decomp first_sample/tail); on a real TPU VM the
+        # RTT is ~0.2 ms. Explicit arithmetic, not a measurement.
+        point["realtime_p99_minus_2rtt_ms"] = (
+            round(point["realtime"]["p99_ms"] - 2 * rtt_ms, 2)
+            if point["realtime"]["n"] > 0 else None)
+        return point
 
     rt_share = dict((p.tier_name, w) for p, w in TPU_TIER_MIX)["realtime"]
     p99_gate_ms = 500.0          # reference docs/performance.md:1047
@@ -566,110 +769,86 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     gc.collect()
     gc.freeze()
     gc.disable()
-    # Seed AFTER the warm burst above: its discarded requests already
-    # moved the cumulative prefix counters, and the first rate point's
-    # delta must not carry them.
-    pc_prev = {"hits": engine.prefix_hits, "misses": engine.prefix_misses,
-               "tokens": engine.cached_prefill_tokens_total}
     try:
+        # Discarded Poisson warm phase (5 s at the top swept rate).
+        log("[poisson-tpu] discarded 5s warm phase ...")
+        run_phase(max(rates), 5.0, collect=False)
         for rate in rates:
             # Duration sized for the realtime sample target at this rate
-            # (bounded: the full sweep must fit the driver's bench window).
-            dur = max(duration_s, min(150.0,
-                                      min_realtime_n / (rate * rt_share)))
-            rng = random.Random(7)
-            handles = []
-            log(f"[poisson-tpu] {rate:.1f} req/s for {dur:.0f}s ...")
-            t_start = time.perf_counter()
-            next_arrival = t_start
-            n_sent = 0
-            while time.perf_counter() - t_start < dur:
-                now = time.perf_counter()
-                if now < next_arrival:
-                    time.sleep(min(0.002, next_arrival - now))
-                    continue
-                next_arrival += rng.expovariate(rate)
-                h = engine.submit(GenRequest(
-                    id=f"pt{rate}-{n_sent}",
-                    prompt=f"load test request {n_sent % 50}",
-                    priority=sample_tier(rng, TPU_TIER_MIX),
-                    max_new_tokens=24))
-                handles.append(h)
-                n_sent += 1
-            # One SHARED drain deadline: a wedged engine must bound the
-            # bench, not stall it per-handle.
-            deadline = time.perf_counter() + 90.0
-            for h in handles:
-                if not h.wait(max(0.0, deadline - time.perf_counter())):
-                    break
-            # Quiesce between rate points: cancel any backlog so the next
-            # point measures ITS offered load, not a saturated predecessor's
-            # leftovers.
-            leftovers = 0
-            for h in handles:
-                if not h.done:
-                    h.cancel()
-                    leftovers += 1
-            if leftovers:
-                quiesce = time.perf_counter() + 30.0
-                while time.perf_counter() < quiesce:
-                    s = engine.get_stats()
-                    if s["pending"] == 0 and s["active"] == 0:
-                        break
-                    time.sleep(0.1)
-            lat: Dict[str, List[float]] = {p.tier_name: [] for p in TIERS}
-            completed = 0
-            for h in handles:
-                if h.done and h.result.finish_reason in ("eos", "length"):
-                    completed += 1
-                    lat[h.request.priority.tier_name].append(h.latency)
-            point: Dict = {"offered_rate": rate, "duration_s": round(dur, 0),
-                           "sent": n_sent, "completed": completed,
-                           "cancelled": leftovers}
-            tier_report(lat, point, f"poisson-tpu@{rate:g}")
-            point["decomp"] = _decomp(handles)
-            point["decomp_realtime"] = _decomp(handles, "realtime")
-            if pc is not None:
-                # Per-point deltas of the engine's cumulative counters.
-                hits, misses = engine.prefix_hits, engine.prefix_misses
-                toks = engine.cached_prefill_tokens_total
-                d_h = hits - pc_prev["hits"]
-                d_m = misses - pc_prev["misses"]
-                point["prefix_cache_hit_rate"] = round(
-                    d_h / max(1, d_h + d_m), 4)
-                point["cached_prefill_tokens"] = toks - pc_prev["tokens"]
-                pc_prev = {"hits": hits, "misses": misses, "tokens": toks}
-                log(f"[poisson-tpu@{rate:g}] prefix cache: "
-                    f"hit_rate={point['prefix_cache_hit_rate']:.2f} "
-                    f"cached_tokens={point['cached_prefill_tokens']}")
-            # The tunnel-free projection: the measured critical path carries
-            # ~2 host↔device round-trips (prefill-sample fetch + chunk
-            # fetch — see decomp first_sample/tail); on a real TPU VM the
-            # RTT is ~0.2 ms. Explicit arithmetic, not a measurement.
-            point["realtime_p99_minus_2rtt_ms"] = (
-                round(point["realtime"]["p99_ms"] - 2 * rtt_ms, 2)
-                if point["realtime"]["n"] > 0 else None)
+            # (bounded: the full sweep must fit the driver's bench
+            # window — tighter when each rate runs multiple repeats).
+            cap = 90.0 if repeats > 1 else 150.0
+            dur = max(duration_s if repeats <= 1 else min(duration_s, 60.0),
+                      min(cap, min_realtime_n / (rate * rt_share)))
+            points = []
+            for rep in range(max(1, repeats)):
+                log(f"[poisson-tpu] {rate:.1f} req/s for {dur:.0f}s "
+                    f"(repeat {rep + 1}/{max(1, repeats)}) ...")
+                points.append(run_phase(rate, dur))
+                gc.collect()         # between phases, outside measurement
+            # Median point by realtime p99. Repeats with NO realtime
+            # completions rank last (their pctl() reads 0.0 — picking
+            # one would silently drop a rate that had a valid repeat);
+            # an even repeat count takes the UPPER middle, so the
+            # default 2-repeat run publishes the conservative point,
+            # never best-of-2. The spread and per-repeat summaries
+            # below record what the median rejected.
+            ranked = sorted(points,
+                            key=lambda pt: (pt["realtime"]["n"] == 0,
+                                            pt["realtime"]["p99_ms"]))
+            valid = [pt for pt in ranked if pt["realtime"]["n"] > 0]
+            pool = valid or ranked
+            point = pool[len(pool) // 2]
+            if len(points) > 1:
+                p99s = [pt["realtime"]["p99_ms"] for pt in points]
+                point["repeats"] = [
+                    {"realtime_p99_ms": pt["realtime"]["p99_ms"],
+                     "realtime_p50_ms": pt["realtime"]["p50_ms"],
+                     "completed": pt["completed"],
+                     "stall_events": pt["stall_events"],
+                     "stall_ms_total": pt["stall_ms_total"]}
+                    for pt in points]
+                point["realtime_p99_spread_ms"] = round(
+                    max(p99s) - min(p99s), 2)
             curve.append(point)
             rt_p99 = point["realtime"]["p99_ms"]
-            if (point["realtime"]["n"] > 0 and completed >= n_sent * 0.95
+            if (point["realtime"]["n"] > 0
+                    and point["completed"] >= point["sent"] * 0.95
                     and rt_p99 <= p99_gate_ms):
                 max_ok_rate = rate
             if headline is None:
                 headline = point
-            gc.collect()             # between points, outside measurement
     finally:
         # GC discipline must not leak past this sweep (main()
         # runs the 8B sweep in the same process).
         gc.enable()
         gc.unfreeze()
+    # Wire-measured first-token latency on the REAL serve path (submit
+    # → first SSE token byte through the HTTP server), next to the
+    # engine-mark first_token_ms the decomp reports.
+    wire = None
+    try:
+        wire = bench_first_token_wire(engine)
+    except Exception as e:  # noqa: BLE001
+        log(f"[wire] first-token wire measurement failed: "
+            f"{type(e).__name__}: {e}")
     prefix_stats = engine.get_stats().get("prefix_cache")
+    stall_totals = (engine.stall_events, round(engine.stall_ms_total, 1))
     engine.stop()
     out: Dict = dict(headline or {})
     out["model"] = cfg.name
     if prefix_stats is not None:
         out["prefix_cache"] = prefix_stats
     out["quant"] = quant or "bf16"
+    out["kv_quant"] = kv_quant or "bf16"
+    out["page_size"] = page_size
+    out["kv_pages"] = num_pages
     out["slots"] = slots
+    out["repeats_per_rate"] = max(1, repeats)
+    out["stall_events_total"] = stall_totals[0]
+    out["stall_ms_total"] = stall_totals[1]
+    if wire is not None:
+        out["first_token_wire_ms"] = wire
     out["host_device_rtt_ms"] = round(rtt_ms, 1)
     out["decode_step_ms_est"] = round(ex.step_ms or 0.0, 3)
     out["warmup_s"] = round(warmup_s, 1)
@@ -708,6 +887,18 @@ def main() -> None:
     sla_model_8b = os.environ.get("LLMQ_BENCH_SLA_MODEL_8B", "llama3-8b")
     sla_rates_8b = [float(r) for r in os.environ.get(
         "LLMQ_BENCH_TPU_POISSON_RATES_8B", "1,2,5").split(",") if r]
+    # Statistics hardening: short repeats per rate, median point +
+    # spread recorded (see bench_poisson_tpu).
+    sla_repeats = int(os.environ.get("LLMQ_BENCH_TPU_REPEATS", "2"))
+    sla_page = int(os.environ.get("LLMQ_BENCH_SLA_PAGE", "16"))
+    # The 8B SLA path serves the TUNED geometry the decode section
+    # measures: 128-token pages + int8 KV → the fused int8-KV kernel
+    # (attention.py's 128-alignment gate) is on the serving path, so
+    # max_rate_realtime_p99_ok_8b measures the real server.
+    sla_page_8b = int(os.environ.get("LLMQ_BENCH_SLA_PAGE_8B", "128"))
+    sla_kv_8b = os.environ.get("LLMQ_BENCH_SLA_KV_QUANT_8B", "int8")
+    if sla_kv_8b in ("bf16", "none"):
+        sla_kv_8b = ""
 
     qres = bench_queue_throughput(n_msgs)
     tiers = bench_poisson_echo(rate, secs)
@@ -721,7 +912,8 @@ def main() -> None:
             log(f"[tpu] decode bench failed: {type(e).__name__}: {e}")
         try:
             tpu_tiers = bench_poisson_tpu(sla_model, sla_rates, sla_secs,
-                                          sla_quant)
+                                          sla_quant, page_size=sla_page,
+                                          repeats=sla_repeats)
         except Exception as e:  # noqa: BLE001
             log(f"[poisson-tpu] failed: {type(e).__name__}: {e}")
         if sla_model_8b and sla_model_8b != sla_model:
@@ -731,7 +923,8 @@ def main() -> None:
                 # budget before an arrival can even join the batch.
                 tpu_tiers_8b = bench_poisson_tpu(
                     sla_model_8b, sla_rates_8b, sla_secs, "int8",
-                    chunk=16)
+                    chunk=16, page_size=sla_page_8b,
+                    kv_quant=sla_kv_8b, repeats=sla_repeats)
             except Exception as e:  # noqa: BLE001
                 log(f"[poisson-tpu-8b] failed: {type(e).__name__}: {e}")
 
@@ -755,6 +948,10 @@ def main() -> None:
                 (tpu_tiers or {}).get("max_rate_realtime_p99_ok"),
             "max_rate_realtime_p99_ok_8b":
                 (tpu_tiers_8b or {}).get("max_rate_realtime_p99_ok"),
+            "first_token_wire_realtime_p50_ms": (
+                ((tpu_tiers_8b or tpu_tiers or tiers or {})
+                 .get("first_token_wire_ms") or {})
+                .get("realtime", {}).get("p50_ms")),
         },
     }
     print(json.dumps(result), flush=True)

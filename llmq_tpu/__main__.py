@@ -95,6 +95,23 @@ class App:
             if cfg.executor.backend == "jax":
                 self._register_chip_resources()
 
+        # Cluster serving plane (llmq_tpu/cluster/, docs/multihost.md):
+        # a non-empty ``cluster.peers`` builds the replica-set router
+        # over THIS process's LoadBalancer — the same instance the API
+        # server's POST /api/v1/endpoints feeds, so runtime-added hosts
+        # receive traffic from the live router with no restart.
+        self.cluster_router = None
+        if cfg.cluster.enabled:
+            from llmq_tpu.cluster import build_cluster_router
+            self.cluster_router = build_cluster_router(
+                cfg, self.load_balancer,
+                state_manager=self.state_manager, engine=self.engine)
+            log.info("cluster plane up: %d peer(s)%s",
+                     len(cfg.cluster.peers),
+                     " + local engine" if (self.engine is not None
+                                           and cfg.cluster.include_local)
+                     else "")
+
         # Split-deployment transport (queueing/spool.py): consumer side
         # pulls spooled messages into the local queues and acks results;
         # gateway side relays drained messages out and applies acks.
@@ -104,14 +121,25 @@ class App:
         self._spool_relay: Optional[threading.Thread] = None
         spool_dir = cfg.queue.spool_dir
 
+        # A gateway with cluster peers gets WORKERS: its queues drain
+        # through the router to the replicas over HTTP (the reference's
+        # gateway accepts messages nothing ever consumes).
+        if self.cluster_router is not None and not with_workers:
+            with_workers = True
         self.workers: List = []
         if with_workers:
-            if self.engine is None:
-                raise ValueError("workers need an engine (use --backend echo "
-                                 "for a model-free process)")
-            process_fn = self.engine.process_fn
+            if self.engine is None and self.cluster_router is None:
+                raise ValueError("workers need an engine or cluster "
+                                 "peers (use --backend echo for a "
+                                 "model-free process)")
+            process_fn = (self.cluster_router.process_fn
+                          if self.cluster_router is not None
+                          else self.engine.process_fn)
             self._spool_ack_failure = None
-            if spool_dir and not with_api:
+            # Spool and cluster are alternative transports; with peers
+            # configured the cluster router owns the dispatch seam.
+            if (spool_dir and not with_api and self.engine is not None
+                    and self.cluster_router is None):
                 process_fn = self._wire_spool_consumer(spool_dir)
             self.workers = self.factory.create_workers(
                 "standard", cfg.queue.worker.count, process_fn,
@@ -128,6 +156,8 @@ class App:
                 load_balancer=self.load_balancer,
                 resource_scheduler=self.resource_scheduler,
                 engine=self.engine,
+                cluster_router=self.cluster_router,
+                drain_hook=self.drain,
                 message_store=self.message_store,
             )
             if spool_dir and not with_workers:
@@ -140,6 +170,60 @@ class App:
                                          cfg.scheduler)
 
         self._stop = threading.Event()
+        #: Set when the stop signal was SIGTERM — the orchestrated
+        #: "please leave the replica set" signal; commands then drain
+        #: before stopping (SIGINT stays an immediate stop).
+        self._term = threading.Event()
+        self._drain_mu = threading.Lock()
+        self._drain_started = False
+        self._drain_done = threading.Event()
+        self._drain_idle = False
+
+    # -- graceful drain (docs/multihost.md) ----------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Leave the replica set gracefully: /health flips to
+        "draining" (peers' probes stop routing here), workers stop
+        pulling NEW messages while in-flight calls finish, then wait —
+        bounded by ``cluster.drain_timeout`` — for the engine to go
+        idle. Returns True when fully idle at the end. Concurrent
+        callers (admin-drain thread vs. the SIGTERM path) converge on
+        ONE drain: late callers BLOCK until it completes and return its
+        result — an instant "done" here would let the stop cascade tear
+        the engine down under the very in-flight work the drain exists
+        to protect."""
+        if timeout is None:
+            timeout = self.cfg.cluster.drain_timeout
+        with self._drain_mu:
+            already = self._drain_started
+            self._drain_started = True
+        if already:
+            self._drain_done.wait(max(0.0, timeout) + 10.0)
+            return self._drain_idle
+        log.info("draining (timeout %.0fs) ...", timeout)
+        if self.api is not None:
+            self.api.draining = True
+        if (self.cluster_router is not None
+                and self.cluster_router._local_endpoint_id):  # noqa: SLF001
+            # Local replica out of the in-process router too.
+            self.cluster_router.drain_endpoint(
+                self.cluster_router._local_endpoint_id)  # noqa: SLF001
+        for w in self.workers:
+            w.stop(wait=True)      # finishes in-flight dispatches
+        deadline = time.monotonic() + max(0.0, timeout)
+        idle = True
+        if self.engine is not None:
+            while time.monotonic() < deadline:
+                s = self.engine.get_stats()
+                if s["active"] == 0 and s["pending"] == 0:
+                    break
+                time.sleep(0.05)
+            else:
+                idle = False
+        log.info("drain complete (idle=%s)", idle)
+        self._drain_idle = idle
+        self._drain_done.set()
+        return idle
 
     def _register_chip_resources(self) -> None:
         """Account the engine's chips in the ResourceScheduler: discover
@@ -377,14 +461,29 @@ class App:
         self._stop.set()
 
     def wait(self) -> None:
-        """Block until SIGINT/SIGTERM."""
+        """Block until SIGINT/SIGTERM. SIGTERM marks the stop as
+        ORCHESTRATED (compose/k8s scale-down) — the command then drains
+        in-flight work before tearing down; SIGINT stays immediate."""
         signal.signal(signal.SIGINT, lambda *a: self._stop.set())
-        signal.signal(signal.SIGTERM, lambda *a: self._stop.set())
+
+        def on_term(*_a) -> None:
+            self._term.set()
+            self._stop.set()
+
+        signal.signal(signal.SIGTERM, on_term)
         try:
             while not self._stop.is_set():
                 self._stop.wait(0.5)
         except KeyboardInterrupt:
             pass
+
+    def shutdown(self) -> None:
+        """wait()-aware teardown: drain first on SIGTERM (or after an
+        admin drain request — drain() then blocks until the in-progress
+        drain really finishes), then the stop cascade."""
+        if self._term.is_set() or self._drain_started:
+            self.drain()
+        self.stop()
 
 
 def _load(args) -> Config:
@@ -395,6 +494,11 @@ def _load(args) -> Config:
         cfg.server.port = args.port
     if args.backend:
         cfg.executor.backend = args.backend
+    if getattr(args, "peers", None):
+        # Comma-separated replica URLs; ClusterConfig.__post_init__
+        # normalizes the string form.
+        cfg.cluster.peers = args.peers
+        cfg.cluster.__post_init__()
     configure_logging(cfg.logging.level, cfg.logging.format,
                       cfg.logging.output)
     _maybe_join_cluster()
@@ -435,7 +539,7 @@ def cmd_serve(args) -> int:
               with_scheduler=True)
     app.start()
     app.wait()
-    app.stop()
+    app.shutdown()
     return 0
 
 
@@ -446,7 +550,7 @@ def cmd_queue_manager(args) -> int:
     log.info("queue-manager consuming with %d workers (%s engine)",
              len(app.workers), cfg.executor.backend)
     app.wait()
-    app.stop()
+    app.shutdown()
     return 0
 
 
@@ -454,8 +558,11 @@ def cmd_gateway(args) -> int:
     cfg = _load(args)
     app = App(cfg, with_api=True, with_workers=False, with_engine=False)
     app.start()
+    if app.cluster_router is not None:
+        log.info("gateway routing to %d endpoint(s)",
+                 len(app.load_balancer.endpoints()))
     app.wait()
-    app.stop()
+    app.shutdown()
     return 0
 
 
@@ -515,6 +622,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port", type=int, help="override server.port")
     parser.add_argument("--backend", choices=["echo", "jax"],
                         help="override executor.backend")
+    parser.add_argument("--peers",
+                        help="comma-separated replica base URLs "
+                             "(override cluster.peers): serve/gateway "
+                             "route through the cluster plane")
     sub = parser.add_subparsers(dest="command", required=True)
     sub.add_parser("serve", help="monolith: API + workers + engine")
     sub.add_parser("queue-manager", help="consumer daemon (no HTTP)")

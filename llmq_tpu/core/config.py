@@ -187,6 +187,60 @@ class LoadBalancerConfig:
                 f"unknown load balancer strategy {self.strategy!r}; valid: {VALID_LB_STRATEGIES}")
 
 
+VALID_CLUSTER_AFFINITY = ("prefix", "session", "none")
+
+
+@dataclass
+class ClusterConfig:
+    """Replica-set serving plane (llmq_tpu/cluster/, docs/multihost.md).
+
+    New scope: the reference has no multi-host dispatch at all (its
+    scheduler fabricates worker URLs nothing ever calls,
+    scheduler.go:299-301). ``peers`` is the whole bring-up story: a
+    non-empty list makes serve/gateway modes construct a ClusterRouter
+    over the listed replica base URLs and install it as the Worker
+    process_fn — no hand-built router, no code changes."""
+    #: Replica base URLs (``http://host:port``). Accepts a YAML list or
+    #: a comma-separated string (the env-var form,
+    #: ``LLMQ_CLUSTER_PEERS=http://a:8080,http://b:8080``).
+    peers: List[str] = field(default_factory=list)
+    #: serve mode: also register THIS process's engine as a
+    #: ``local://`` endpoint so the replica set includes the local chip.
+    include_local: bool = True
+    #: Per-dispatch failover budget: how many OTHER replicas to try when
+    #: a dispatch fails with a transport/replica error (timeouts never
+    #: fail over — the work may have executed). 0 disables in-dispatch
+    #: failover (the worker retry path + DLQ remain the backstop).
+    failover_retries: int = 2
+    #: Load above which conversation affinity spills to another replica
+    #: (Endpoint.load is connections-based, in [0, 1]).
+    spill_load: float = 0.9
+    #: Affinity policy: "prefix" (conversation placement handles via the
+    #: state manager, EWMA spill — the default), "session" (LB session
+    #: map only), "none".
+    affinity: str = "prefix"
+    #: Graceful-drain bound for SIGTERM / admin drain: stop new
+    #: dispatch, wait up to this many seconds for in-flight work.
+    drain_timeout: float = 30.0
+    #: HTTP transport budget per dispatch to a peer (seconds).
+    peer_timeout: float = 120.0
+
+    def __post_init__(self) -> None:
+        if isinstance(self.peers, str):
+            self.peers = [p for p in
+                          (s.strip() for s in self.peers.split(","))
+                          if p]
+        self.peers = [p.rstrip("/") for p in self.peers]
+        if self.affinity not in VALID_CLUSTER_AFFINITY:
+            raise ValueError(
+                f"unknown cluster affinity {self.affinity!r}; "
+                f"valid: {VALID_CLUSTER_AFFINITY}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.peers)
+
+
 @dataclass
 class ConversationConfig:
     """Unified conversation service (reference spreads this over three
@@ -306,6 +360,7 @@ class Config:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     resource_scheduler: ResourceSchedulerConfig = field(default_factory=ResourceSchedulerConfig)
     loadbalancer: LoadBalancerConfig = field(default_factory=LoadBalancerConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     conversation: ConversationConfig = field(default_factory=ConversationConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)

@@ -355,6 +355,12 @@ class InferenceEngine:
         #: separate.
         self._fetch_lanes: Dict[str, tuple] = {}
         self.steps = 0
+        #: Device/tunnel stall accounting (bench satellite: BENCH rate
+        #: points carry these as deltas so a poisoned latency point is
+        #: attributable): a "stall" is a device transfer that exceeded
+        #: the 5 s warning threshold in _service_while / chunk fetch.
+        self.stall_events = 0
+        self.stall_ms_total = 0.0
 
     # -- submission ----------------------------------------------------------
 
@@ -1524,6 +1530,12 @@ class InferenceEngine:
                             "(engine %s keeps servicing arrivals)",
                             self.name)
                 warned = True
+        if warned:
+            # Counted, not just logged: BENCH rate points carry the
+            # deltas (stall_events / stall_ms_total) so a poisoned p99
+            # is attributable in the artifact itself.
+            self.stall_events += 1
+            self.stall_ms_total += (time.perf_counter() - t0) * 1e3
 
     def _process_chunk(self, infl: _InflightChunk) -> None:
         """Commit an in-flight chunk's tokens. Uses the dispatch-time
@@ -1541,8 +1553,15 @@ class InferenceEngine:
         pre-reconcile admission pass)."""
         box = infl.fetch_box
         if box is None:
+            t0 = time.perf_counter()
             with self._prof.span("engine.chunk_fetch"):
                 out = infl.handle.fetch()
+            dt = time.perf_counter() - t0
+            if dt > 5.0:          # same stall threshold as _service_while
+                log.warning("blocking chunk fetch stalled %.1f s "
+                            "(engine %s)", dt, self.name)
+                self.stall_events += 1
+                self.stall_ms_total += dt * 1e3
         else:
             with self._prof.span("engine.chunk_fetch"):
                 self._service_while(box["ev"])
@@ -1840,6 +1859,8 @@ class InferenceEngine:
             "kv_pages_used": self.allocator.used(),
             "kv_pages_total": self.allocator.total,
             "cached_conversations": cached,
+            "stall_events": self.stall_events,
+            "stall_ms_total": round(self.stall_ms_total, 1),
             "profile": self._prof.summary(),
         }
         if self._prefix_cache is not None:

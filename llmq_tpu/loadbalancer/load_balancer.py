@@ -51,11 +51,14 @@ DEFAULT_MODEL_TYPE = "llm"
 
 
 class EndpointStatus(str, enum.Enum):
-    """load_balancer.go:26-32."""
+    """load_balancer.go:26-32, plus DRAINING (new scope: the cluster
+    plane's graceful-removal state — no NEW dispatch, in-flight work
+    finishes, probes don't resurrect it; see docs/multihost.md)."""
 
     HEALTHY = "healthy"
     DEGRADED = "degraded"
     UNHEALTHY = "unhealthy"
+    DRAINING = "draining"
 
 
 @dataclass
@@ -205,10 +208,49 @@ class LoadBalancer:
             ep.status = EndpointStatus(status)
             return True
 
+    def set_draining(self, endpoint_id: str, draining: bool = True) -> bool:
+        """Enter/leave the DRAINING state. Leaving re-enters via
+        DEGRADED so the probe must prove health before full traffic."""
+        with self._mu:
+            ep = self._endpoints.get(endpoint_id)
+            if ep is None:
+                return False
+            if draining:
+                ep.status = EndpointStatus.DRAINING
+            elif ep.status == EndpointStatus.DRAINING:
+                ep.status = EndpointStatus.DEGRADED
+                ep.consecutive_successes = 0
+            return True
+
     # -- selection (:234-294) ------------------------------------------------
 
+    def acquire_endpoint(self, endpoint_id: str,
+                         session_id: Optional[str] = None
+                         ) -> Optional[Endpoint]:
+        """Targeted acquisition for affinity-directed dispatch (the
+        cluster router picks the endpoint, the LB keeps the books).
+        Returns None — caller must select another way — when the
+        endpoint is gone, UNHEALTHY/DRAINING, or out of headroom."""
+        with self._mu:
+            ep = self._endpoints.get(endpoint_id)
+            if (ep is None
+                    or ep.status in (EndpointStatus.UNHEALTHY,
+                                     EndpointStatus.DRAINING)
+                    or (ep.max_connections > 0
+                        and ep.connections >= ep.max_connections)):
+                return None
+            ep.connections += 1
+            ep.total_requests += 1
+            if session_id and self.config.session_affinity:
+                self._sessions[session_id] = (
+                    ep.id, self._clock.now() + self.config.session_ttl)
+            return ep
+
     def get_endpoint(self, message: Optional[Message] = None,
-                     session_id: Optional[str] = None) -> Endpoint:
+                     session_id: Optional[str] = None,
+                     exclude: Optional[set] = None) -> Endpoint:
+        """``exclude``: endpoint ids to skip — the failover path re-picks
+        among the replicas it has NOT already tried this dispatch."""
         model_type = DEFAULT_MODEL_TYPE
         if message is not None:
             model_type = message.metadata.get("model_type", DEFAULT_MODEL_TYPE)
@@ -220,7 +262,9 @@ class LoadBalancer:
                     eid, expires = hit
                     ep = self._endpoints.get(eid)
                     if (ep is not None and expires > self._clock.now()
-                            and ep.status != EndpointStatus.UNHEALTHY
+                            and ep.status not in (EndpointStatus.UNHEALTHY,
+                                                  EndpointStatus.DRAINING)
+                            and eid not in (exclude or ())
                             and ep.model_type == model_type
                             and (ep.max_connections <= 0
                                  or ep.connections < ep.max_connections)):
@@ -230,7 +274,7 @@ class LoadBalancer:
                             eid, self._clock.now() + self.config.session_ttl)
                         return ep
                     self._sessions.pop(session_id, None)
-            candidates = self._healthy_endpoints(model_type)
+            candidates = self._healthy_endpoints(model_type, exclude)
             if not candidates:
                 raise NoEndpointError(
                     f"no healthy endpoint for model type {model_type!r}")
@@ -242,12 +286,17 @@ class LoadBalancer:
                     ep.id, self._clock.now() + self.config.session_ttl)
             return ep
 
-    def _healthy_endpoints(self, model_type: str) -> List[Endpoint]:
-        """healthy + degraded, with connection headroom (:672-682)."""
+    def _healthy_endpoints(self, model_type: str,
+                           exclude: Optional[set] = None) -> List[Endpoint]:
+        """healthy + degraded, with connection headroom (:672-682).
+        DRAINING endpoints take no new dispatch."""
         out = []
         for eid in self._by_type.get(model_type, []):
+            if eid in (exclude or ()):
+                continue
             ep = self._endpoints[eid]
-            if ep.status == EndpointStatus.UNHEALTHY:
+            if ep.status in (EndpointStatus.UNHEALTHY,
+                             EndpointStatus.DRAINING):
                 continue
             if ep.max_connections > 0 and ep.connections >= ep.max_connections:
                 continue
@@ -359,6 +408,13 @@ class LoadBalancer:
                 if ep.id not in self._endpoints:
                     continue
                 ep.last_health_check = self._clock.now()
+                if ep.status == EndpointStatus.DRAINING:
+                    # Drain is an OPERATOR state, not a health verdict:
+                    # probes must neither resurrect a draining endpoint
+                    # nor demote it (set_draining(False) re-enters via
+                    # DEGRADED and the probe takes over from there).
+                    results[ep.id] = ep.status
+                    continue
                 if ok:
                     ep.consecutive_failures = 0
                     ep.consecutive_successes += 1
@@ -418,6 +474,8 @@ class LoadBalancer:
                                 if e.status == EndpointStatus.DEGRADED),
                 "unhealthy": sum(1 for e in self._endpoints.values()
                                  if e.status == EndpointStatus.UNHEALTHY),
+                "draining": sum(1 for e in self._endpoints.values()
+                                if e.status == EndpointStatus.DRAINING),
                 "active_sessions": len(self._sessions),
                 "endpoints": [e.to_dict() for e in self._endpoints.values()],
             }

@@ -74,21 +74,27 @@ class EngineRouter:
         self._engines[eid] = client
         return ep
 
-    def process_fn(self, ctx, msg: Message) -> None:
-        """Worker seam: route one message to the least-loaded (per
-        strategy) healthy engine, with conversation affinity."""
-        session = msg.conversation_id or None
-        ep = self.lb.get_endpoint(msg, session_id=session)
+    def engine_for(self, ep: Endpoint):
+        """The dispatchable engine/transport behind an endpoint.
+        Endpoints registered without one (e.g. via the REST admin
+        route) get an HTTP transport built and attached on first use,
+        so runtime-registered remote hosts are routable too. Returns
+        None when the endpoint has neither."""
         engine = ep.metadata.get("engine")
         if engine is None and ep.url.startswith(("http://", "https://")):
-            # Endpoint registered without a transport (e.g. via the
-            # REST admin route): build one on first use and attach it,
-            # so runtime-registered remote hosts are routable too.
             from llmq_tpu.loadbalancer.transport import HttpEngineClient
 
             engine = HttpEngineClient(ep.url)
             ep.metadata["engine"] = engine
             self._engines[ep.id] = engine
+        return engine
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        """Worker seam: route one message to the least-loaded (per
+        strategy) healthy engine, with conversation affinity."""
+        session = msg.conversation_id or None
+        ep = self.lb.get_endpoint(msg, session_id=session)
+        engine = self.engine_for(ep)
         if engine is None:
             self.lb.release_endpoint(ep.id, is_error=True)
             raise RuntimeError(

@@ -60,7 +60,13 @@ class HttpEngineClient:
         except (urllib.error.URLError, OSError, ValueError):
             return False
         # A serve peer reports its engine thread; "stopped" means the
-        # process is up but cannot generate — unhealthy for routing.
+        # process is up but cannot generate — unhealthy for routing. A
+        # peer that announces status "draining" (SIGTERM / admin drain,
+        # docs/multihost.md) is deliberately leaving the replica set:
+        # also unhealthy for routing, so remote LBs stop dispatching
+        # without any cluster-wide control channel.
+        if data.get("status") == "draining":
+            return False
         return data.get("engine", "running") == "running"
 
     def process_fn(self, ctx, msg: Message) -> None:

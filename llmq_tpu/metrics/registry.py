@@ -105,6 +105,32 @@ class QueueMetrics:
             f"{ns}_prefix_cache_pages",
             "KV pages currently held by the radix prefix cache",
             ["engine"], registry=registry)
+        # Cluster serving plane (llmq_tpu/cluster/, docs/multihost.md):
+        # ``reason`` is why the endpoint was chosen — "affinity" (the
+        # conversation's prefix-holding replica), "spill" (affine
+        # replica saturated/draining → rerouted), "select" (no affinity;
+        # LB strategy), "failover" (retried here after another replica
+        # failed mid-dispatch).
+        self.cluster_dispatch = Counter(
+            f"{ns}_cluster_dispatch_total",
+            "Messages dispatched to a cluster endpoint",
+            ["endpoint", "reason"], registry=registry)
+        self.cluster_affinity_hit_rate = Gauge(
+            f"{ns}_cluster_affinity_hit_rate",
+            "Fraction of affinity-eligible dispatches routed to the "
+            "conversation's prefix-holding replica (lifetime)",
+            registry=registry)
+        self.cluster_failovers = Counter(
+            f"{ns}_cluster_failovers_total",
+            "In-dispatch failovers away from a failed endpoint",
+            ["endpoint"], registry=registry)
+        self.cluster_drains = Counter(
+            f"{ns}_cluster_drains_total",
+            "Drain transitions per endpoint", ["endpoint"],
+            registry=registry)
+        self.cluster_endpoints = Gauge(
+            f"{ns}_cluster_endpoints", "Registered endpoints by status",
+            ["status"], registry=registry)
 
 
 def get_metrics() -> QueueMetrics:

@@ -7,6 +7,10 @@ against the topology table — and exercise ``import_hf_llama`` end-to-end
 on a synthetic 2-layer safetensors checkpoint.
 """
 
+import os
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
@@ -24,6 +28,8 @@ from llmq_tpu.models.llama import (  # noqa: E402
     weight_bytes,
 )
 from llmq_tpu.scheduling.topology import TpuTopology  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class TestParamCounts:
@@ -88,6 +94,88 @@ class TestHbmFit:
     def test_kv_bytes_per_token(self):
         # 8B: 2 × 32 layers × 8 kv-heads × 128 dim × 2 B = 131072 B/token.
         assert kv_bytes_per_token(get_config("llama3-8b")) == 131072
+
+
+_AOT_70B = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 16)
+except AttributeError:
+    pass
+import jax.numpy as jnp
+from llmq_tpu.models.llama import (forward_decode, get_config,
+                                   init_kv_pages, init_params_quantized)
+from llmq_tpu.parallel.mesh import make_mesh
+from llmq_tpu.parallel.sharding import (batch_sharding,
+                                        kv_cache_shardings,
+                                        param_shardings)
+
+assert len(jax.devices()) == 16, len(jax.devices())
+# The flagship serving config (BASELINE #5): llama3-70b int8 on a
+# 2-host v5e-16, dp x tp = 2 x 8 — tp=8 so the 8 GQA KV heads still
+# shard (tp=16 would force full KV replication per chip).
+cfg = get_config("llama3-70b", max_seq_len=8192)
+mesh = make_mesh({{"dp": 2, "tp": 8}})
+B, page_size = 8, 128
+mpps = cfg.max_seq_len // page_size
+num_pages = B * mpps + 1
+
+# ABSTRACT params/cache: eval_shape traces the initializers without a
+# byte of HBM — 70B int8 is ~70 GB that CI never materializes.
+abs_params = jax.eval_shape(
+    lambda: init_params_quantized(jax.random.PRNGKey(0), cfg))
+abs_cache = jax.eval_shape(lambda: init_kv_pages(cfg, num_pages,
+                                                 page_size))
+
+def with_sharding(avals, shardings):
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        avals, shardings)
+
+a_params = with_sharding(abs_params,
+                         param_shardings(cfg, mesh, quantized=True))
+a_cache = with_sharding(dict(abs_cache), dict(kv_cache_shardings(cfg, mesh)))
+a_tok = jax.ShapeDtypeStruct((B,), jnp.int32,
+                             sharding=batch_sharding(mesh, 1))
+a_pos = jax.ShapeDtypeStruct((B,), jnp.int32,
+                             sharding=batch_sharding(mesh, 1))
+a_bt = jax.ShapeDtypeStruct((B, mpps), jnp.int32,
+                            sharding=batch_sharding(mesh, 2))
+
+f = jax.jit(lambda p, t, pos, c, bt: forward_decode(p, cfg, t, pos, c, bt))
+compiled = f.lower(a_params, a_tok, a_pos, a_cache, a_bt).compile()
+
+# Record that the flagship sharding FITS a v5e chip: per-device
+# argument bytes (weights shard over tp; cache over tp KV heads) under
+# the 16 GB HBM with scheduler headroom.
+mem = compiled.memory_analysis()
+per_dev_gb = mem.argument_size_in_bytes / 1e9
+assert per_dev_gb < 16.0 * 0.9, f"{{per_dev_gb:.1f}} GB/chip"
+print(f"AOT70B OK {{per_dev_gb:.2f}} GB/chip", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+def test_70b_dp2tp8_aot_lowering_compiles():
+    """Flagship multi-chip validity without HBM: the REAL llama3-70b
+    int8 config AOT-lowers and compiles at dp*tp=16 from
+    ShapeDtypeStructs on a 16-virtual-device CPU mesh, and the
+    per-device argument footprint fits a 16 GB v5e chip. Subprocess:
+    the test session's JAX is pinned to 8 devices (conftest)."""
+    script = _AOT_70B.format(repo=REPO)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))
+           and k not in ("PYTHONPATH", "PYTHONSTARTUP")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "AOT70B OK" in p.stdout, p.stdout
 
 
 class TestHfImport:
