@@ -364,10 +364,15 @@ class App:
                 target = orig
             else:
                 target = done
+            from llmq_tpu import observability
             if done.status == MessageStatus.COMPLETED:
                 mgr.complete_message(target)
+                observability.record(done.id, "completed",
+                                     source="spool")
             else:
                 mgr.fail_message(target, 0.0)
+                observability.record(done.id, "failed", source="spool",
+                                     reason=done.error)
 
         self.spool_collector = SpoolCollector(spool_dir, on_done)
 
@@ -494,6 +499,8 @@ def _load(args) -> Config:
         cfg.server.port = args.port
     if args.backend:
         cfg.executor.backend = args.backend
+    if getattr(args, "log_format", None):
+        cfg.logging.format = args.log_format
     if getattr(args, "peers", None):
         # Comma-separated replica URLs; ClusterConfig.__post_init__
         # normalizes the string form.
@@ -501,6 +508,10 @@ def _load(args) -> Config:
         cfg.cluster.__post_init__()
     configure_logging(cfg.logging.level, cfg.logging.format,
                       cfg.logging.output)
+    # Trace plane (docs/observability.md): size/enable the process
+    # flight recorder before any component records a stage event.
+    from llmq_tpu import observability
+    observability.configure(cfg.observability)
     _maybe_join_cluster()
     return cfg
 
@@ -609,7 +620,7 @@ def cmd_check(args) -> int:
             time.sleep(0.05)
     finally:
         app.stop()
-    print("CHECK OK" if ok else "CHECK FAILED")
+    log.info("CHECK %s", "OK" if ok else "FAILED")
     return 0 if ok else 1
 
 
@@ -622,6 +633,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port", type=int, help="override server.port")
     parser.add_argument("--backend", choices=["echo", "jax"],
                         help="override executor.backend")
+    parser.add_argument("--log-format", choices=["json", "console"],
+                        help="override logging.format (structured JSON "
+                             "with request_id/conversation_id/endpoint "
+                             "fields, or human console lines)")
     parser.add_argument("--peers",
                         help="comma-separated replica base URLs "
                              "(override cluster.peers): serve/gateway "

@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from llmq_tpu import __version__
+from llmq_tpu import __version__, observability
 from llmq_tpu.api.message_store import MessageStore
 from llmq_tpu.core.config import Config, default_config
 from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
@@ -70,9 +70,12 @@ class _SSEStream:
     where the generator was never started, which a generator-finally
     alone cannot cover."""
 
-    def __init__(self, events, on_close=None) -> None:
+    def __init__(self, events, on_close=None, headers=None) -> None:
         self.events = events
         self.on_close = on_close
+        #: Extra response headers (e.g. ``traceparent`` so a streaming
+        #: client can correlate its SSE stream with the trace plane).
+        self.headers = headers or {}
 
     def __iter__(self):
         return iter(self.events)
@@ -89,12 +92,17 @@ class _Request:
     """Parsed request handed to route handlers."""
 
     def __init__(self, method: str, path: str, params: Dict[str, str],
-                 query: Dict[str, List[str]], body: bytes) -> None:
+                 query: Dict[str, List[str]], body: bytes,
+                 headers: Optional[Dict[str, str]] = None) -> None:
         self.method = method
         self.path = path
         self.params = params          # path captures, e.g. {"id": ...}
         self.query = query
         self._body = body
+        #: Request headers, lower-cased keys (HTTP headers are
+        #: case-insensitive; direct dispatch() callers pass any case).
+        self.headers = {str(k).lower(): v
+                        for k, v in (headers or {}).items()}
 
     def json(self) -> Dict[str, Any]:
         if not self._body:
@@ -229,7 +237,9 @@ class ApiServer:
         r("GET", f"{v1}/cluster/stats", self.get_cluster_stats)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
         r("POST", f"{v1}/generate", self.generate_sync)
+        r("GET", f"{v1}/requests/:id/trace", self.get_request_trace)
         adm = f"{v1}/admin"
+        r("GET", f"{adm}/flightrecorder", self.get_flight_recorder)
         r("POST", f"{adm}/drain", self.drain_self)
         r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
         r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
@@ -240,8 +250,9 @@ class ApiServer:
         r("POST", f"{adm}/dead-letter/requeue-all",
           self.requeue_all_dead_letter_messages)
 
-    def dispatch(self, method: str, raw_path: str,
-                 body: bytes) -> Tuple[int, Any, str]:
+    def dispatch(self, method: str, raw_path: str, body: bytes,
+                 headers: Optional[Dict[str, str]] = None,
+                 ) -> Tuple[int, Any, str]:
         """Route one request. Returns (status, payload, content_type)."""
         parsed = urlparse(raw_path)
         path = parsed.path.rstrip("/") or "/"
@@ -254,7 +265,11 @@ class ApiServer:
             matched_path = True
             if m != method:
                 continue
-            req = _Request(method, path, match.groupdict(), query, body)
+            req = _Request(method, path, match.groupdict(), query, body,
+                           headers)
+            from llmq_tpu.utils.logging import (bind_log_context,
+                                                reset_log_context)
+            ltoken = bind_log_context(endpoint=path)
             try:
                 status, payload = handler(req)
             except ApiError as e:
@@ -266,6 +281,8 @@ class ApiServer:
             except Exception as e:  # noqa: BLE001
                 log.exception("handler error on %s %s", method, path)
                 return 500, {"error": f"internal error: {e}"}, "application/json"
+            finally:
+                reset_log_context(ltoken)
             if isinstance(payload, bytes):
                 return status, payload, "text/plain; version=0.0.4"
             if isinstance(payload, _SSEStream):
@@ -340,6 +357,15 @@ class ApiServer:
                      for k in ("word_count", "char_count", "sentiment",
                                "is_question") if k in msg.metadata})
         mgr = self._manager()
+        # Stamp BEFORE the push: a near-idle worker can pop and stamp
+        # "scheduled" before this thread resumes, and a scheduled <
+        # enqueued inversion would drop the queue_wait sample exactly
+        # in the low-latency regime it measures. (A push rejection
+        # leaves a lone enqueued event — ring-bounded, harmless.)
+        observability.record(msg.id, "enqueued",
+                             priority=msg.priority.tier_name,
+                             conversation_id=msg.conversation_id,
+                             user_id=msg.user_id)
         mgr.push_message(msg)
         self.store.record(msg)
         if msg.conversation_id and self.state_manager is not None:
@@ -442,9 +468,20 @@ class ApiServer:
                     log.exception("conversation update failed for %s",
                                   msg.id)
 
+            observability.record(msg.id, "enqueued",
+                                 priority=msg.priority.tier_name,
+                                 conversation_id=msg.conversation_id,
+                                 user_id=msg.user_id, stream=True)
             tokens: "Queue[int]" = Queue()
             handle = self.engine.submit(GenRequest.from_message(msg),
                                         on_token=tokens.put)
+            # The SSE path bypasses queue + router: submit IS the
+            # dispatch (engine-side events follow from the handle).
+            observability.record(msg.id, "dispatched",
+                                 endpoint=getattr(self.engine, "name",
+                                                  "engine"),
+                                 reason="stream",
+                                 priority=msg.priority.tier_name)
             tokenizer = self.engine.tokenizer
             timeout = (explicit_timeout
                        if explicit_timeout and explicit_timeout > 0
@@ -571,7 +608,10 @@ class ApiServer:
             finally:
                 release_once()
 
-        return 200, _SSEStream(guarded(), on_close=release_once)
+        return 200, _SSEStream(
+            guarded(), on_close=release_once,
+            headers={"traceparent": observability.make_traceparent(msg.id),
+                     "X-Request-Id": msg.id})
 
     def get_message(self, req: _Request) -> Tuple[int, Any]:
         msg = self.store.get(req.params["id"])
@@ -824,14 +864,78 @@ class ApiServer:
             raise ApiError(400, f"invalid message: {e}") from None
         if not msg.id:
             msg.id = new_id()
+        # Cross-process stitch, replica half (docs/observability.md):
+        # the caller's W3C trace context is recorded onto this host's
+        # timeline (same trace id — both sides derive it from msg.id;
+        # the header makes the link explicit and spec-visible), and the
+        # hop arrival doubles as the replica-local "dispatched" stamp
+        # so admission latency is measurable from this host alone.
+        traceparent = req.headers.get(observability.TRACEPARENT_HEADER)
+        parsed_tp = observability.parse_traceparent(traceparent)
+        observability.record(
+            msg.id, "dispatched", reason="remote",
+            priority=msg.priority.tier_name,
+            traceparent=traceparent or "",
+            parent_span_id=parsed_tp.span_id if parsed_tp else "")
         try:
             self.engine.process_fn(_Deadline(timeout), msg)
         except TimeoutError as e:
             raise ApiError(504, str(e)) from None
         except RuntimeError as e:
             raise ApiError(500, f"generation failed: {e}") from None
-        return 200, {"message_id": msg.id, "response": msg.response,
-                     "usage": msg.metadata.get("usage", {})}
+        out = {"message_id": msg.id, "response": msg.response,
+               "usage": msg.metadata.get("usage", {})}
+        if getattr(self.config.observability, "propagate_trace", True):
+            rec = observability.get_recorder()
+            if rec.enabled:
+                tl = rec.get(msg.id)
+                if tl is not None:
+                    # Ship this host's events back for the gateway's
+                    # recorder to merge into one stitched timeline.
+                    out["trace"] = [e.to_dict()
+                                    for e in tl.sorted_events()]
+        return 200, out
+
+    # -- observability (docs/observability.md) -------------------------------
+
+    def get_request_trace(self, req: _Request) -> Tuple[int, Any]:
+        """One request's stitched lifecycle timeline — gateway- and
+        replica-side stage events in one host-labeled view.
+        ``?format=chrome`` exports a chrome://tracing / Perfetto
+        document, stitching in the executor's SpanRecorder spans (and
+        a pointer to the jax.profiler capture when LLMQ_TRACE_DIR is
+        live)."""
+        rec = observability.get_recorder()
+        if not rec.enabled:
+            raise ApiError(503, "observability disabled "
+                                "(set observability.enabled)")
+        tl = rec.get(req.params["id"])
+        if tl is None:
+            return 404, {"error": "no trace for that request id "
+                                  "(evicted or never recorded)"}
+        if req.q("format") == "chrome":
+            from llmq_tpu.utils.profiling import trace_dir
+            spans = None
+            prof = getattr(self.engine, "_prof", None)
+            if prof is not None:
+                spans = prof.snapshot()
+            return 200, observability.chrome_trace(
+                [tl], spans=spans, jax_trace_dir=trace_dir())
+        return 200, tl.to_dict()
+
+    def get_flight_recorder(self, req: _Request) -> Tuple[int, Any]:
+        """Flight-recorder state: ring stats, the most recent request
+        timelines, and the slow/failed retention buffer."""
+        rec = observability.get_recorder()
+        try:
+            limit = int(req.q("limit", "50"))
+        except ValueError:
+            raise ApiError(400, "limit must be an integer") from None
+        return 200, {
+            **rec.get_stats(),
+            "recent": [t.summary() for t in rec.recent(limit)],
+            "slow": [t.summary() for t in rec.slow()],
+        }
 
     # -- admin ---------------------------------------------------------------
 
@@ -921,7 +1025,8 @@ class ApiServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 status, payload, ctype = server.dispatch(
-                    self.command, self.path, body)
+                    self.command, self.path, body,
+                    dict(self.headers.items()))
                 if isinstance(payload, _SSEStream):
                     # Streaming: chunked, flushed per event; length
                     # unknown up front, so close delimits the body.
@@ -934,6 +1039,8 @@ class ApiServer:
                         self.send_header("Content-Type", ctype)
                         self.send_header("Cache-Control", "no-cache")
                         self.send_header("Connection", "close")
+                        for hk, hv in payload.headers.items():
+                            self.send_header(hk, hv)
                         self._cors_headers()
                         self.end_headers()
                         for event in payload:

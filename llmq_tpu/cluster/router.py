@@ -40,13 +40,15 @@ import threading
 import time
 from typing import Dict, Optional
 
+from llmq_tpu import observability
 from llmq_tpu.core.config import ClusterConfig
 from llmq_tpu.core.errors import NoEndpointError
 from llmq_tpu.core.types import Message
 from llmq_tpu.loadbalancer.load_balancer import (Endpoint, EndpointStatus,
                                                  LoadBalancer)
 from llmq_tpu.loadbalancer.router import EngineRouter
-from llmq_tpu.utils.logging import get_logger
+from llmq_tpu.utils.logging import (bind_log_context, get_logger,
+                                    reset_log_context)
 
 log = get_logger("cluster")
 
@@ -193,6 +195,11 @@ class ClusterRouter(EngineRouter):
                     f"endpoint {ep.id} has no attached engine and no "
                     f"transport for url {ep.url!r}")
                 continue
+            observability.record(msg.id, "dispatched", endpoint=ep.id,
+                                 reason=reason,
+                                 priority=msg.priority.tier_name)
+            ltoken = bind_log_context(endpoint=ep.id,
+                                      request_id=msg.id)
             t0 = time.perf_counter()
             try:
                 engine.process_fn(ctx, msg)
@@ -210,10 +217,14 @@ class ClusterRouter(EngineRouter):
                     self.failovers += 1
                 if self._metrics:
                     self._metrics.cluster_failovers.labels(ep.id).inc()
+                observability.record(msg.id, "failover", endpoint=ep.id,
+                                     error=repr(e))
                 log.warning("dispatch of %s to %s failed (%s); "
                             "retrying on another replica",
                             msg.id, ep.id, e)
                 continue
+            finally:
+                reset_log_context(ltoken)
             self._commit(msg, ep, session, reason,
                          time.perf_counter() - t0)
             return

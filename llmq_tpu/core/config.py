@@ -263,6 +263,30 @@ class LoggingConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Request-lifecycle trace plane (llmq_tpu/observability/,
+    docs/observability.md). ``enabled: false`` is a hard off-switch:
+    no events are recorded anywhere and every ``record`` call returns
+    after one attribute check."""
+    enabled: bool = True
+    #: Most recent request timelines kept in the flight-recorder ring.
+    recorder_capacity: int = 1024
+    #: Finished timelines retained separately because they breached the
+    #: SLA or failed (survive ring eviction).
+    slow_capacity: int = 256
+    #: End-to-end latency above which a finished request counts as an
+    #: SLA breach and is retained in the slow buffer; <= 0 disables
+    #: breach tracking (failures are still retained).
+    sla_ms: float = 5000.0
+    #: Feed the Prometheus stage histograms on each terminal event.
+    emit_metrics: bool = True
+    #: Replica side: include this host's recorded events for the
+    #: request in the ``POST /api/v1/generate`` response so the
+    #: gateway can stitch a cross-process timeline.
+    propagate_trace: bool = True
+
+
+@dataclass
 class MetricsConfig:
     """Reference config.go:100-104. Unlike the reference (which never
     mounts promhttp — SURVEY.md §5), the API server really serves this."""
@@ -364,6 +388,8 @@ class Config:
     conversation: ConversationConfig = field(default_factory=ConversationConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     executor: ExecutorConfig = field(default_factory=ExecutorConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)

@@ -783,9 +783,14 @@ class InferenceEngine:
         if self._metrics:
             self._metrics.preemptions.labels(
                 self.name, victim.req.priority.tier_name).inc()
+        # Engine-thread logs carry the request identity via explicit
+        # fields (the contextvar binding lives on worker/API threads).
         log.info("preempted %s (%s)%s", victim.req.id,
                  victim.req.priority.tier_name,
-                 " releasing pages" if release_pages else "")
+                 " releasing pages" if release_pages else "",
+                 extra={"fields": {
+                     "request_id": victim.req.id,
+                     "conversation_id": victim.req.conversation_id}})
 
     def _release_sequence_pages(self, seq: _Sequence) -> None:
         """Take ``seq``'s KV pages back into the pool. The sequence will
@@ -839,7 +844,8 @@ class InferenceEngine:
             cid = min(self._conv_cache,
                       key=lambda c: self._conv_cache[c].last_used)
             self._drop_conversation_locked(cid, invalidate=False)
-        log.info("evicted conversation KV %s under pool pressure", cid)
+        log.info("evicted conversation KV %s under pool pressure", cid,
+                 extra={"fields": {"conversation_id": cid}})
         return True
 
     def _reclaim_pending_pages(self, requester: _Sequence) -> bool:
@@ -858,7 +864,9 @@ class InferenceEngine:
             return False
         self._release_sequence_pages(worst)
         log.info("reclaimed pages of pending %s for %s",
-                 worst.req.id, requester.req.id)
+                 worst.req.id, requester.req.id,
+                 extra={"fields": {"request_id": requester.req.id,
+                                   "victim_id": worst.req.id}})
         return True
 
     def _alloc_pages(self, n: int,
@@ -1117,6 +1125,8 @@ class InferenceEngine:
         # path — the accounting below must stay identical between them).
         work = []
         for seq in cands:
+            seq.handle.marks.setdefault("prefill_start",
+                                        time.perf_counter())
             chunk_len = buckets[-1] if buckets else len(seq.todo_ids)
             chunk = seq.todo_ids[:chunk_len]
             seq.todo_ids = seq.todo_ids[chunk_len:]
@@ -1703,7 +1713,9 @@ class InferenceEngine:
             try:
                 handle._on_token(nxt)
             except Exception:  # noqa: BLE001 — a broken stream consumer
-                log.exception("on_token callback failed; detaching")
+                log.exception("on_token callback failed; detaching",
+                              extra={"fields": {
+                                  "request_id": seq.req.id}})
                 handle._on_token = None
         if self._metrics:
             self._metrics.generated_tokens.labels(
@@ -1761,7 +1773,10 @@ class InferenceEngine:
                     if len(seq.written_ids) != seq.pos:
                         log.warning(
                             "written_ids/pos mismatch for %s: %d vs %d",
-                            seq.req.id, len(seq.written_ids), seq.pos)
+                            seq.req.id, len(seq.written_ids), seq.pos,
+                            extra={"fields": {
+                                "request_id": seq.req.id,
+                                "conversation_id": conv}})
                     if publish:
                         self._prefix_cache.insert(seq.written_ids,
                                                   list(seq.pages))
@@ -1790,6 +1805,38 @@ class InferenceEngine:
                 log.exception("prefix-handle record failed for %s", conv)
         self._finish(seq, reason)
 
+    def _record_trace(self, seq: _Sequence, reason: str) -> None:
+        """Stamp the engine-side lifecycle events for a finished
+        sequence into the flight recorder (docs/observability.md).
+        Handle marks are perf_counter-based; the wall anchor shifts
+        them onto the shared clock. One call per request — never per
+        token — so the trace plane stays off the decode hot path."""
+        from llmq_tpu import observability
+        rec = observability.get_recorder()
+        if not rec.enabled:
+            return
+        anchor = observability.perf_anchor()
+        prio = seq.req.priority.tier_name
+        marks = seq.handle.marks
+        events = [(stage, marks[stage] + anchor,
+                   {"engine": self.name, "priority": prio})
+                  for stage in ("admitted", "prefill_start",
+                                "prefill_done", "first_token")
+                  if stage in marks]
+        # Cancellation (client closed the stream / gave up) is its own
+        # terminal: neither a success nor a failure the flight recorder
+        # should retain.
+        terminal = ("completed" if reason in ("eos", "length")
+                    else "cancelled" if reason == "cancelled"
+                    else "failed")
+        events.append((terminal, time.time(),
+                       {"engine": self.name, "priority": prio,
+                        "finish_reason": reason,
+                        "completion_tokens": len(seq.generated),
+                        "prompt_tokens": len(seq.prompt_ids),
+                        "cached_tokens": seq.cached_len}))
+        rec.record_many(seq.req.id, events)
+
     def _finish(self, seq: _Sequence, reason: str, error: str = "") -> None:
         if seq.prefix_match is not None:
             self._prefix_cache.unlock(seq.prefix_match)
@@ -1803,6 +1850,7 @@ class InferenceEngine:
                 if self._conv_busy.get(conv) == seq.order:
                     del self._conv_busy[conv]
                 self._conv_drop_pending.discard(conv)
+        self._record_trace(seq, reason)
         res = GenResult(
             text=self.tokenizer.decode(seq.generated),
             tokens=list(seq.generated),

@@ -100,6 +100,10 @@ class EngineRouter:
             raise RuntimeError(
                 f"endpoint {ep.id} has no attached engine and no "
                 f"transport for url {ep.url!r}")
+        from llmq_tpu import observability
+        observability.record(msg.id, "dispatched", endpoint=ep.id,
+                             reason="select",
+                             priority=msg.priority.tier_name)
         t0 = time.perf_counter()
         try:
             engine.process_fn(ctx, msg)

@@ -83,6 +83,11 @@ class HttpEngineClient:
                 timeout = min(self.timeout, rem)
         payload = msg.to_dict()
         payload["timeout"] = timeout
+        # W3C trace context rides the hop (docs/observability.md): the
+        # replica binds its engine events to the SAME trace id, so the
+        # gateway's flight recorder can stitch one cross-host timeline.
+        from llmq_tpu import observability
+        traceparent = observability.make_traceparent(msg.id)
         # Socket timeout gets HEADROOM over the server's generation
         # budget: the server enforces ``timeout`` itself and answers a
         # deadline miss with a 504 we can classify. With socket timeout
@@ -94,7 +99,8 @@ class HttpEngineClient:
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/generate",
             data=json.dumps(payload).encode("utf-8"),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers={"Content-Type": "application/json",
+                     "traceparent": traceparent}, method="POST")
         try:
             with urllib.request.urlopen(req, timeout=sock_timeout) as resp:
                 data = json.loads(resp.read().decode("utf-8"))
@@ -131,3 +137,9 @@ class HttpEngineClient:
         usage = data.get("usage")
         if usage:
             msg.metadata["usage"] = usage
+        trace_events = data.get("trace")
+        if trace_events:
+            # Stitch the replica's engine-side stage events into THIS
+            # process's timeline for the request — the cross-process
+            # half of GET /api/v1/requests/:id/trace.
+            observability.get_recorder().merge(msg.id, trace_events)

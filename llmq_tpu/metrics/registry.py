@@ -39,6 +39,10 @@ _SINGLETON: Optional["QueueMetrics"] = None
 
 _WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 300)
 _PROC_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
+#: Sub-request stage latencies (admission waits, prefill, token gaps)
+#: live well under a second; finer low end than the queue buckets.
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1, 2.5, 5, 10, 30)
 
 
 class QueueMetrics:
@@ -131,6 +135,53 @@ class QueueMetrics:
         self.cluster_endpoints = Gauge(
             f"{ns}_cluster_endpoints", "Registered endpoints by status",
             ["status"], registry=registry)
+        # Request-lifecycle stage histograms (llmq_tpu/observability/,
+        # docs/observability.md): observed ONCE per request at its
+        # terminal trace event, from the flight recorder's stage
+        # deltas. ``endpoint`` is the cluster endpoint id when the
+        # request crossed the router, else the engine name, else
+        # "local".
+        stage_labels = ["priority", "endpoint"]
+        self.stage_queue_wait = Histogram(
+            f"{ns}_stage_queue_wait_seconds",
+            "enqueued → scheduled (time in the priority queues)",
+            stage_labels, buckets=_WAIT_BUCKETS, registry=registry)
+        self.stage_dispatch = Histogram(
+            f"{ns}_stage_dispatch_seconds",
+            "scheduled → dispatched (worker pop to endpoint handoff)",
+            stage_labels, buckets=_STAGE_BUCKETS, registry=registry)
+        self.stage_admission = Histogram(
+            f"{ns}_stage_admission_seconds",
+            "dispatched → admitted (engine admission wait)",
+            stage_labels, buckets=_STAGE_BUCKETS, registry=registry)
+        self.stage_prefill = Histogram(
+            f"{ns}_stage_prefill_seconds",
+            "prefill_start → first_token",
+            stage_labels, buckets=_STAGE_BUCKETS, registry=registry)
+        self.ttft = Histogram(
+            f"{ns}_ttft_seconds",
+            "enqueued → first_token (user-perceived time to first token)",
+            stage_labels, buckets=_WAIT_BUCKETS, registry=registry)
+        self.decode_interarrival = Histogram(
+            f"{ns}_decode_interarrival_seconds",
+            "Mean inter-token gap over the request's decode phase",
+            stage_labels, buckets=_STAGE_BUCKETS, registry=registry)
+        self.sla_breaches = Counter(
+            f"{ns}_sla_breaches_total",
+            "Requests whose end-to-end latency breached "
+            "observability.sla_ms", ["priority"], registry=registry)
+        self.flightrecorder_timelines = Gauge(
+            f"{ns}_flightrecorder_timelines",
+            "Request timelines currently held in the flight-recorder "
+            "ring", registry=registry)
+        self.flightrecorder_slow_retained = Gauge(
+            f"{ns}_flightrecorder_slow_retained",
+            "Finished timelines retained for SLA breach / failure",
+            registry=registry)
+        self.dead_letter_depth = Gauge(
+            f"{ns}_dead_letter_depth",
+            "Messages currently parked in a dead-letter queue",
+            ["queue"], registry=registry)
 
 
 def get_metrics() -> QueueMetrics:
@@ -144,4 +195,11 @@ def get_metrics() -> QueueMetrics:
 def exposition() -> bytes:
     """Prometheus text exposition for the API server's /metrics route."""
     get_metrics()  # ensure the families exist even before first increment
+    try:
+        # Stage-histogram observations are deferred off the request hot
+        # path; the scrape is where they land (docs/observability.md).
+        from llmq_tpu.observability.recorder import get_recorder
+        get_recorder().flush_metrics()
+    except Exception:  # noqa: BLE001 — scrape must not fail on trace plane
+        pass
     return generate_latest(REGISTRY)

@@ -1,0 +1,40 @@
+"""Request-lifecycle trace plane (docs/observability.md).
+
+The paper's queue/scheduler plane plus the cluster plane span four
+process boundaries (API → queue → router → replica engine); this
+package makes one request legible across all of them:
+
+- :mod:`trace` — W3C ``traceparent`` propagation, trace ids derived
+  from ``Message.id`` so every process agrees without coordination;
+- :mod:`recorder` — the bounded :class:`FlightRecorder` of per-request
+  stage timelines (ring + SLA-breach retention), feeding the
+  Prometheus stage histograms on each request's terminal event;
+- :mod:`chrome` — Chrome/Perfetto trace export stitching host
+  timelines with executor ``SpanRecorder`` spans.
+
+The usage contract for instrumented layers is one line:
+
+    from llmq_tpu import observability
+    observability.record(msg.id, "scheduled", priority=..., ...)
+
+which no-ops fast when ``observability.enabled`` is false.
+"""
+
+from llmq_tpu.observability.chrome import chrome_trace, perf_anchor  # noqa: F401
+from llmq_tpu.observability.recorder import (  # noqa: F401
+    TERMINAL_STAGES,
+    FlightRecorder,
+    Timeline,
+    TraceEvent,
+    configure,
+    get_recorder,
+    record,
+)
+from llmq_tpu.observability.trace import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    TraceContext,
+    make_traceparent,
+    new_span_id,
+    parse_traceparent,
+    trace_id_for,
+)

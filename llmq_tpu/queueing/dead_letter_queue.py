@@ -64,6 +64,18 @@ class DeadLetterQueue:
         self._handlers: List[Handler] = []
         self._lock = threading.Lock()
 
+    def _set_depth_gauge(self) -> None:
+        """Expose the parked-message count (alerting input — a rising
+        DLQ is the terminal symptom of replica/engine failure,
+        deployments/alerts.yml). Best-effort: depth tracking must not
+        couple the DLQ to the metrics plane."""
+        try:
+            from llmq_tpu.metrics.registry import get_metrics
+            get_metrics().dead_letter_depth.labels(self.name).set(
+                len(self._items))
+        except Exception:  # noqa: BLE001
+            pass
+
     def add_handler(self, handler: Handler) -> None:
         with self._lock:
             self._handlers.append(handler)
@@ -84,6 +96,7 @@ class DeadLetterQueue:
                 log.warning("DLQ %s full; evicted oldest item %s", self.name, evicted_id)
             self._items[message.id] = item
             handlers = list(self._handlers)
+            self._set_depth_gauge()
         for h in handlers:
             try:
                 h(item)
@@ -109,12 +122,15 @@ class DeadLetterQueue:
 
     def remove(self, message_id: str) -> bool:
         with self._lock:
-            return self._items.pop(message_id, None) is not None
+            removed = self._items.pop(message_id, None) is not None
+            self._set_depth_gauge()
+            return removed
 
     def clear(self) -> int:
         with self._lock:
             n = len(self._items)
             self._items.clear()
+            self._set_depth_gauge()
             return n
 
     # -- requeue (dead_letter_queue.go:187-258) ------------------------------
@@ -125,6 +141,7 @@ class DeadLetterQueue:
         before the error propagates — a message is never in neither place."""
         with self._lock:
             item = self._items.pop(message_id, None)
+            self._set_depth_gauge()
         if item is None:
             raise MessageNotFoundError(message_id)
         msg = item.message
@@ -139,6 +156,7 @@ class DeadLetterQueue:
             msg.retry_count, msg.status, msg.error, msg.scheduled_at = prev
             with self._lock:
                 self._items[message_id] = item
+                self._set_depth_gauge()
             raise
         return msg
 

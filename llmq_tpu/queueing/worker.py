@@ -35,13 +35,15 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from llmq_tpu import observability
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.config import RetryConfig, WorkerConfig
 from llmq_tpu.core.types import Message, MessageStatus
 from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
 from llmq_tpu.queueing.delayed_queue import DelayedQueue
 from llmq_tpu.queueing.queue_manager import QueueManager
-from llmq_tpu.utils.logging import get_logger
+from llmq_tpu.utils.logging import (bind_log_context, get_logger,
+                                    reset_log_context)
 
 log = get_logger("worker")
 
@@ -394,9 +396,15 @@ class Worker:
 
     def _run_one(self, msg: Message) -> None:
         release = True
+        # Every log line emitted while this message is being processed
+        # — including from the engine/router layers below — carries the
+        # request identity (docs/observability.md).
+        token = bind_log_context(request_id=msg.id,
+                                 conversation_id=msg.conversation_id)
         try:
             release = self._process_message(msg)
         finally:
+            reset_log_context(token)
             if release:
                 # False → the watchdog already freed this slot when it
                 # abandoned the (then-wedged) call.
@@ -406,6 +414,9 @@ class Worker:
         """Process one message. Returns True if the caller must release
         the concurrency slot (False when the watchdog already did)."""
         start = self._clock.now()
+        observability.record(msg.id, "scheduled", worker=self.name,
+                             priority=msg.priority.tier_name,
+                             retry_count=msg.retry_count)
         deadline = start + msg.timeout if msg.timeout and msg.timeout > 0 else None
         ctx = ProcessContext(deadline, self._clock)
         rec: Optional[_Inflight] = None
@@ -465,6 +476,13 @@ class Worker:
             self.manager.complete_message(msg, elapsed)
             with self.stats._mu:
                 self.stats.succeeded += 1
+            usage = (msg.metadata or {}).get("usage") or {}
+            observability.record(
+                msg.id, "completed", worker=self.name,
+                priority=msg.priority.tier_name,
+                endpoint=(msg.metadata or {}).get("endpoint_id", ""),
+                completion_tokens=usage.get("completion_tokens", 0),
+                process_seconds=round(elapsed, 6))
             return True
         reason = (f"timeout after {elapsed:.3f}s ({err!r})" if timed_out
                   else repr(err))
@@ -525,6 +543,10 @@ class Worker:
             qname = self.manager.stash_for_retry(msg)
             msg.status = MessageStatus.PENDING
             self.delayed_queue.schedule_after(msg, delay, qname)
+            observability.record(msg.id, "retry_scheduled",
+                                 priority=msg.priority.tier_name,
+                                 retry=msg.retry_count,
+                                 delay_seconds=delay, reason=reason)
             log.info("message %s retry %d/%d in %.2fs (%s)",
                      msg.id, msg.retry_count, msg.max_retries, delay, reason)
             return
@@ -538,6 +560,11 @@ class Worker:
             self.dead_letter_queue.push(msg, reason, qname)
             with self.stats._mu:
                 self.stats.dead_lettered += 1
+        observability.record(msg.id, "failed",
+                             priority=msg.priority.tier_name,
+                             endpoint=(msg.metadata or {}).get(
+                                 "endpoint_id", ""),
+                             timed_out=timed_out, reason=reason)
         if self.on_permanent_failure is not None:
             try:
                 self.on_permanent_failure(msg, reason)

@@ -4,17 +4,52 @@ The reference mixes structured zap (internal/*) with plain ``log``
 (cmd/{api-gateway,queue-manager,scheduler}) — SURVEY.md §5. Here one
 configuration serves every component: JSON or console format per
 ``LoggingConfig`` (config.go:95-99 analogue).
+
+Request correlation (docs/observability.md): layers that handle one
+request bind ``request_id`` / ``conversation_id`` / ``endpoint`` into a
+contextvar (:func:`bind_log_context`); both formatters merge the bound
+fields into every record emitted while the binding is live, so a log
+line from deep inside the worker/router carries the request identity
+without every call site threading it through. Contextvars are
+per-thread(-ish) by construction, so concurrent workers don't bleed
+fields into each other's lines. Per-record ``extra={"fields": {...}}``
+still works and wins over the bound context on key collisions.
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import sys
 import time
-from typing import Optional
+from typing import Any, Dict, Optional
 
 _CONFIGURED = False
+
+#: Fields bound for the current logical request (dict is replaced, not
+#: mutated — tokens restore the previous binding exactly).
+_LOG_CTX: contextvars.ContextVar[Dict[str, Any]] = contextvars.ContextVar(
+    "llmq_log_ctx", default={})
+
+
+def bind_log_context(**fields: Any) -> contextvars.Token:
+    """Bind request-scoped fields (empty values are skipped) on top of
+    any existing binding. Returns a token for :func:`reset_log_context`."""
+    merged = dict(_LOG_CTX.get())
+    merged.update({k: v for k, v in fields.items() if v})
+    return _LOG_CTX.set(merged)
+
+
+def reset_log_context(token: Optional[contextvars.Token] = None) -> None:
+    if token is not None:
+        _LOG_CTX.reset(token)
+    else:
+        _LOG_CTX.set({})
+
+
+def current_log_context() -> Dict[str, Any]:
+    return dict(_LOG_CTX.get())
 
 
 class JsonFormatter(logging.Formatter):
@@ -27,10 +62,29 @@ class JsonFormatter(logging.Formatter):
         }
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
+        ctx = _LOG_CTX.get()
+        if ctx:
+            out.update(ctx)
         extra = getattr(record, "fields", None)
         if extra:
             out.update(extra)
         return json.dumps(out, default=str)
+
+
+class ConsoleFormatter(logging.Formatter):
+    """Human format with the bound/extra fields appended as k=v."""
+
+    def __init__(self) -> None:
+        super().__init__("%(asctime)s %(levelname)-5s %(name)s %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        fields = dict(_LOG_CTX.get())
+        fields.update(getattr(record, "fields", None) or {})
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            return f"{base} [{kv}]"
+        return base
 
 
 def configure_logging(level: str = "info", fmt: str = "json", output: str = "stdout") -> None:
@@ -42,8 +96,7 @@ def configure_logging(level: str = "info", fmt: str = "json", output: str = "std
     if fmt == "json":
         handler.setFormatter(JsonFormatter())
     else:
-        handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname)-5s %(name)s %(message)s"))
+        handler.setFormatter(ConsoleFormatter())
     root.addHandler(handler)
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
     root.propagate = False

@@ -373,6 +373,34 @@ def test_config_only_multihost_bringup_failover_and_live_add():
         cstats = _get(gw, "/api/v1/cluster/stats")
         assert cstats["affinity_hit_rate"] > 0
 
+        # Phase 2b: the gateway-originated request's stitched trace
+        # (docs/observability.md) contains REPLICA-side engine events,
+        # carried home over the generate_sync response after the
+        # traceparent header propagated out on the dispatch.
+        tl = _get(gw, f"/api/v1/requests/{t1}/trace")
+        stages = {e["stage"] for e in tl["events"]}
+        assert {"enqueued", "scheduled", "dispatched", "admitted",
+                "prefill_start", "first_token", "completed"} <= stages, \
+            stages
+        # Gateway and replica are distinct OS processes — the timeline
+        # must be cross-host.
+        assert len(tl["hosts"]) >= 2, tl["hosts"]
+        # Engine events came from the replica process, not the gateway.
+        gw_host = next(e["host"] for e in tl["events"]
+                       if e["stage"] == "enqueued")
+        eng_hosts = {e["host"] for e in tl["events"]
+                     if e["stage"] in ("admitted", "first_token")}
+        assert eng_hosts and gw_host not in eng_hosts, (gw_host, tl)
+        # The replica recorded the gateway's W3C context verbatim.
+        assert tl["trace_id"] == t1.replace("-", "")
+        remote_dispatch = [e for e in tl["events"]
+                           if e["stage"] == "dispatched"
+                           and e["meta"].get("traceparent")]
+        assert remote_dispatch, tl["events"]
+        assert remote_dispatch[0]["meta"]["traceparent"].startswith(
+            f"00-{tl['trace_id']}-")
+        assert "ttft" in tl["stage_latencies_ms"]
+
         # Phase 3: SIGKILL one replica → zero lost messages.
         replicas[0].send_signal(signal.SIGKILL)
         replicas[0].wait(timeout=10)
@@ -380,6 +408,19 @@ def test_config_only_multihost_bringup_failover_and_live_add():
                       {"content": f"post-kill {i}", "user_id": "t"}
                       )["message_id"] for i in range(8)]
         assert drain_all(mids) == set()     # failover, nothing lost
+        # Acceptance: after the failover phase the gateway's /metrics
+        # exposes the stage histograms with non-zero samples (the
+        # scrape itself flushes the deferred observations).
+        with urllib.request.urlopen(f"{gw}/metrics", timeout=10) as r:
+            metrics_text = r.read().decode()
+        for fam in ("llm_queue_stage_queue_wait_seconds_count",
+                    "llm_queue_stage_dispatch_seconds_count",
+                    "llm_queue_ttft_seconds_count"):
+            samples = [ln for ln in metrics_text.splitlines()
+                       if ln.startswith(fam)]
+            assert samples, f"{fam} missing from /metrics"
+            assert any(float(ln.rsplit(" ", 1)[1]) > 0
+                       for ln in samples), f"{fam} has zero samples"
 
         # Phase 4: add a THIRD replica at runtime through the API; the
         # LIVE router must start dispatching to it.

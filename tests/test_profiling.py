@@ -52,6 +52,64 @@ class TestSpanRecorder:
             with annotate("x"):
                 raise ValueError("original")
 
+    def test_concurrent_record_snapshot_clear(self):
+        """The multi-worker serve path has N dispatch threads recording
+        spans while the API stats route snapshots/summarizes and admin
+        paths clear — all four must interleave without losing the lock
+        discipline (no RuntimeError from mutating the deque mid-copy,
+        no torn summaries, ring bound respected throughout)."""
+        import threading
+        import time as _time
+
+        rec = SpanRecorder(capacity=256)
+        stop = threading.Event()
+        errors = []
+
+        def worker(i):
+            n = 0
+            try:
+                while not stop.is_set():
+                    with rec.span(f"dispatch.{i}", seq=n):
+                        pass
+                    rec.record("engine.decode_chunk", 0.0, 0.001,
+                               {"w": i})
+                    n += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    snap = rec.snapshot()
+                    assert len(snap) <= 256
+                    summ = rec.summary()
+                    for d in summ.values():
+                        assert d["count"] >= 1
+                    len(rec)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def clearer():
+            try:
+                while not stop.is_set():
+                    _time.sleep(0.01)
+                    rec.clear()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=worker, args=(i,))
+                    for i in range(4)]
+                   + [threading.Thread(target=reader) for _ in range(2)]
+                   + [threading.Thread(target=clearer)])
+        for t in threads:
+            t.start()
+        _time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+        assert len(rec.snapshot()) <= 256
+
 
 class TestDeviceTrace:
     def test_noop_without_env(self, monkeypatch):
