@@ -92,6 +92,11 @@ class App:
                 lambda md: eng.prefill_estimate(
                     str(md.get("conversation_id", "")),
                     int(md.get("prompt_tokens", 0) or 0)))
+            # The scheduler LEARNS the serving geometry's real prefill
+            # rate (budgeted, under mixed batching) from the engine's
+            # completed admissions instead of assuming a static figure.
+            self.engine.on_prefill_observed = (
+                self.resource_scheduler.observe_prefill)
             if cfg.executor.backend == "jax":
                 self._register_chip_resources()
 

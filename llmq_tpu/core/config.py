@@ -342,6 +342,42 @@ class PrefixCacheConfig:
 
 
 @dataclass
+class MixedBatchConfig:
+    """Token-budget mixed prefill+decode batching (docs/architecture.md
+    "Mixed step"). When pending prefill work coexists with active decode
+    rows, the engine fuses up to ``prefill_token_budget`` tokens of
+    prefill slices into the SAME device program as the decode chunk —
+    decode latency is then bounded by the budget instead of the longest
+    admitted prompt. ``enabled: false`` is a hard off-switch: the engine
+    schedules exactly as it did before the subsystem existed (dedicated
+    prefill programs serialized with decode chunks)."""
+    enabled: bool = True
+    #: Max prefill tokens fused into one mixed iteration, across all
+    #: slices. The decode rows' per-chunk stall is bounded by the time
+    #: this many prefill tokens take.
+    prefill_token_budget: int = 128
+    #: Prefill sequences whose next slice can ride one mixed iteration
+    #: (the compiled program's slice-row count; each row is
+    #: ``prefill_token_budget // max_slices`` tokens wide).
+    max_slices: int = 2
+
+    def __post_init__(self) -> None:
+        if self.prefill_token_budget < 8:
+            raise ValueError(
+                "mixed_batch.prefill_token_budget must be >= 8 "
+                f"(got {self.prefill_token_budget})")
+        if not 1 <= self.max_slices <= 16:
+            raise ValueError(
+                f"mixed_batch.max_slices must be in [1, 16] "
+                f"(got {self.max_slices})")
+
+    @property
+    def slice_tokens(self) -> int:
+        """Width of one compiled slice row."""
+        return max(1, self.prefill_token_budget // self.max_slices)
+
+
+@dataclass
 class ExecutorConfig:
     """Continuous-batching engine knobs (new scope)."""
     backend: str = "echo"               # echo | jax
@@ -360,6 +396,7 @@ class ExecutorConfig:
     preemption: bool = True
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    mixed_batch: MixedBatchConfig = field(default_factory=MixedBatchConfig)
 
 
 @dataclass

@@ -31,6 +31,14 @@ def build_engine(cfg: Config, *, name: str = "engine0",
     tokenizer = get_tokenizer(getattr(cfg.model, "tokenizer_path", ""))
     metrics_on = cfg.metrics.enabled if enable_metrics is None else enable_metrics
 
+    mixed = getattr(ex, "mixed_batch", None)
+    mixed_on = bool(getattr(mixed, "enabled", False))
+    # Executor-side mixed geometry: S slice rows × T tokens (the
+    # compiled program's shapes). Disabled → S = 0 → no mixed program
+    # is built, and the engine keeps the exact unfused scheduling.
+    mixed_slices = int(getattr(mixed, "max_slices", 0)) if mixed_on else 0
+    mixed_slice_tokens = (int(mixed.slice_tokens) if mixed_on else 0)
+
     if ex.backend == "echo":
         executor = EchoExecutor(
             batch_size=ex.max_batch_size,
@@ -39,7 +47,9 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             max_pages_per_seq=max(
                 1, cfg.model.max_seq_len // ex.page_size),
             eos_id=tokenizer.eos_id,
-            chunk_size=ex.decode_chunk)
+            chunk_size=ex.decode_chunk,
+            mixed_prefill_slices=mixed_slices,
+            mixed_slice_tokens=mixed_slice_tokens)
     elif ex.backend == "jax":
         import jax
         import jax.numpy as jnp
@@ -115,6 +125,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             chunk_size=ex.decode_chunk,
             prefill_batch=ex.prefill_batch,
             cache_dtype=(jnp.int8 if kv_quant == "int8" else None),
+            mixed_prefill_slices=mixed_slices,
+            mixed_slice_tokens=mixed_slice_tokens,
             mesh=mesh)
         if warmup:
             executor.warmup()
@@ -132,9 +144,12 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         kv_pin_ttl=ex.kv_pin_ttl,
         enable_metrics=metrics_on,
         tier_max_wait=tier_max_wait,
-        prefix_cache=getattr(ex, "prefix_cache", None))
+        prefix_cache=getattr(ex, "prefix_cache", None),
+        mixed_batch=mixed)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
-             "prefix_cache=%s)",
+             "prefix_cache=%s mixed_batch=%s)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
-             "on" if getattr(ex.prefix_cache, "enabled", False) else "off")
+             "on" if getattr(ex.prefix_cache, "enabled", False) else "off",
+             (f"on(budget={mixed.prefill_token_budget}"
+              f"x{mixed_slices})" if mixed_on else "off"))
     return engine

@@ -170,7 +170,7 @@ class _Sequence:
                  "prefill_start", "carry", "written_ids", "rebuild",
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
                  "first_handle", "eff_prio", "arrival", "prefix_match",
-                 "reuse_counted")
+                 "reuse_counted", "mixed_pending", "pf_tokens_run")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -222,6 +222,13 @@ class _Sequence:
         #: Hit/miss counted for this REQUEST (first admission only —
         #: a shed-and-rebuilt sequence must not re-count its reuse).
         self.reuse_counted = False
+        #: A prefill slice of this sequence rides the in-flight MIXED
+        #: chunk: no further slice may dispatch until it reconciles
+        #: (positions would collide). Cleared at chunk processing.
+        self.mixed_pending = False
+        #: Prefill tokens actually run for this admission (all dispatch
+        #: paths) — feeds the learned prefill-rate EWMA at completion.
+        self.pf_tokens_run = 0
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -233,15 +240,19 @@ class _InflightChunk:
     Processing uses the SNAPSHOT refs — a slot re-assigned after
     dispatch belongs to a sequence that never participated.
     ``fetch_box`` is the fetcher thread's completion cell
-    ({ev, out, err}); None when the engine fetches inline."""
+    ({ev, out, err}); None when the engine fetches inline.
+    ``pf`` is set for MIXED chunks: the (seq, n_tokens, final)
+    snapshot of the prefill slices fused into the program — their
+    handle.fetch() returns (decode tokens, slice first-tokens)."""
 
-    __slots__ = ("handle", "seqs", "budgets", "fetch_box")
+    __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf")
 
-    def __init__(self, handle, seqs, budgets) -> None:
+    def __init__(self, handle, seqs, budgets, pf=None) -> None:
         self.handle = handle
         self.seqs = seqs          # List[Optional[_Sequence]], len B
         self.budgets = budgets    # np.ndarray (B,) int32
         self.fetch_box = None
+        self.pf = pf              # List[(seq, n_tokens, final)] | None
 
 
 @dataclass
@@ -278,6 +289,7 @@ class InferenceEngine:
         clock: Optional[Clock] = None,
         tier_max_wait: Optional[Dict[Priority, float]] = None,
         prefix_cache=None,
+        mixed_batch=None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -361,6 +373,32 @@ class InferenceEngine:
         #: the 5 s warning threshold in _service_while / chunk fetch.
         self.stall_events = 0
         self.stall_ms_total = 0.0
+        #: Token-budget mixed prefill+decode batching
+        #: (docs/architecture.md "Mixed step"). ``mixed_batch`` accepts
+        #: a core.config.MixedBatchConfig or anything with the same
+        #: fields; None/disabled keeps the exact pre-mixed scheduling
+        #: (the config's hard off-switch).
+        self._mixed_cfg = (mixed_batch
+                           if mixed_batch is not None
+                           and getattr(mixed_batch, "enabled", False)
+                           else None)
+        self.mixed_steps = 0
+        self.mixed_prefill_tokens_total = 0
+        #: Decode-stall attribution: estimated ms decode rows spent (or
+        #: would spend) behind prefill work dispatched while they were
+        #: active. Unfused prefill programs serialize with the decode
+        #: chunk on the device queue — their full slice counts; mixed
+        #: iterations bound it by the token budget.
+        self.prefill_stall_events = 0
+        self.prefill_stall_ms_total = 0.0
+        #: Learned prefill throughput (tokens/s EWMA over completed
+        #: admissions) — drives the stall estimate above and, via
+        #: ``on_prefill_observed``, the ResourceScheduler's budgeted
+        #: prefill-rate estimator.
+        self.prefill_tps_ewma: Optional[float] = None
+        #: Optional ``fn(tokens: int, seconds: float)`` invoked once per
+        #: completed prefill (e.g. ResourceScheduler.observe_prefill).
+        self.on_prefill_observed = None
 
     # -- submission ----------------------------------------------------------
 
@@ -583,7 +621,11 @@ class InferenceEngine:
             # fetch-wait servicing made resolves early).
             nxt = None
             if (not self._has_scheduling_work()
-                    and not self._geometry_changed(infl)):
+                    and not self._geometry_changed(infl)
+                    and not self._mixed_work_waiting()):
+                # Mixed batching: pending prefill slices must ride the
+                # next host-assembled MIXED chunk — a speculative
+                # decode-only chunk would push them out a full cycle.
                 nxt = self._dispatch_speculative(infl)
             # Resolve AFTER dispatch, BEFORE processing: join rows'
             # first tokens must commit before any of their chunk rows
@@ -606,8 +648,12 @@ class InferenceEngine:
                 if self._admit():
                     self._advance_prefill()
                 # Then assemble the next chunk fresh from the
-                # just-reconciled state.
-                self._decode_once()
+                # just-reconciled state — fused with budgeted prefill
+                # slices when mixed batching has both kinds of work.
+                if self._mixed_applicable():
+                    self._mixed_once()
+                else:
+                    self._decode_once()
             self._set_gauges()
             return True
         # No chunk in flight: DISPATCH before resolving — a final
@@ -617,7 +663,10 @@ class InferenceEngine:
         # the join). Sync executors never produce first_handles, so
         # the join-commit ordering (first token at resolve, rows at
         # the next reconcile) is preserved on every path.
-        stepped = self._decode_once()
+        if self._mixed_applicable():
+            stepped = self._mixed_once()
+        else:
+            stepped = self._decode_once()
         resolved = self._resolve_prefills()
         return resolved or admitted or prefilled or stepped
 
@@ -1093,7 +1142,7 @@ class InferenceEngine:
         """
         cands = [s for s in self._slots
                  if s is not None and not s.prefilled
-                 and s.first_handle is None]
+                 and s.first_handle is None and not s.mixed_pending]
         # Reap EVERY cancelled candidate — a cancelled low-tier prompt
         # must not hold its slot and pages just because more urgent
         # prefill work keeps winning the head-of-line pick.
@@ -1105,7 +1154,16 @@ class InferenceEngine:
                 reaped = True
         if not cands:
             return reaped
+        decode_active = any(s is not None and s.prefilled
+                            for s in self._slots)
+        if self._mixed_on() and decode_active:
+            # Mixed mode owns prefill while decode rows are hot: the
+            # next mixed iteration runs these sequences' slices INSIDE
+            # the decode program (budget-bounded) instead of dedicated
+            # bucket programs that would stall it for the whole bucket.
+            return reaped
         buckets = getattr(self.executor, "prefill_buckets", None)
+        t_dispatch0 = time.perf_counter()
         prefill_async = getattr(self.executor, "prefill_async", None)
         # Async executors: dispatch ONE bucket for EVERY waiting
         # sequence this step (the programs just queue on the device —
@@ -1171,9 +1229,14 @@ class InferenceEngine:
                                               seq.req.temperature,
                                               seq.slot)
 
+        dispatched = sum(len(c) for _, c in work)
+        self._note_prefill_dispatch(
+            dispatched, time.perf_counter() - t_dispatch0,
+            decode_active=decode_active, fused=False)
         for (seq, chunk), handle in zip(work, handles):
             seq.todo_pos += len(chunk)
             seq.pos = seq.todo_pos
+            seq.pf_tokens_run += len(chunk)
             seq.written_ids.extend(chunk)
             if seq.todo_ids:
                 continue                    # more buckets next step
@@ -1221,6 +1284,55 @@ class InferenceEngine:
             self._complete_prefill(seq, int(first))
         return True
 
+    def _note_prefill_dispatch(self, tokens: int, host_seconds: float,
+                               *, decode_active: bool,
+                               fused: bool) -> None:
+        """Account one round of prefill dispatches as decode-stall when
+        decode rows were active. The stall is the LARGER of the
+        measured host time (sync executors block right here) and the
+        learned device-time estimate (async dispatches return in µs
+        while the program still serializes with — or, fused, rides
+        inside — the decode chunk). Mixed iterations bound ``tokens``
+        by the budget; that bound is exactly what this histogram makes
+        visible."""
+        if tokens <= 0:
+            return
+        est_ms = host_seconds * 1e3
+        if self.prefill_tps_ewma and self.prefill_tps_ewma > 0:
+            est_ms = max(est_ms,
+                         tokens / self.prefill_tps_ewma * 1e3)
+        if not decode_active:
+            return
+        self.prefill_stall_events += 1
+        self.prefill_stall_ms_total += est_ms
+        if self._metrics:
+            self._metrics.prefill_stall_ms.labels(
+                self.name, "mixed" if fused else "program").observe(
+                    est_ms)
+
+    def _observe_prefill_rate(self, seq: _Sequence) -> None:
+        """Feed the learned prefill-rate EWMA (and the registered
+        scheduler hook) from a completed admission's measured
+        prefill_start → prefill_done span."""
+        marks = seq.handle.marks
+        t0 = marks.get("prefill_start")
+        t1 = marks.get("prefill_done")
+        tokens = seq.pf_tokens_run
+        if t0 is None or t1 is None or t1 <= t0 or tokens <= 0:
+            return
+        dt = t1 - t0
+        rate = tokens / dt
+        if self.prefill_tps_ewma is None:
+            self.prefill_tps_ewma = rate
+        else:
+            self.prefill_tps_ewma = (0.8 * self.prefill_tps_ewma
+                                     + 0.2 * rate)
+        if self.on_prefill_observed is not None:
+            try:
+                self.on_prefill_observed(tokens, dt)
+            except Exception:  # noqa: BLE001 — accounting, not a gate
+                log.exception("on_prefill_observed hook failed")
+
     def _complete_prefill(self, seq: _Sequence, first: int) -> None:
         """Admission-completion after the final prefill chunk."""
         if seq.todo_rebuild and seq.generated:
@@ -1231,6 +1343,7 @@ class InferenceEngine:
                                  seq.prefill_start)
         seq.prefilled = True
         seq.handle.marks.setdefault("prefill_done", time.perf_counter())
+        self._observe_prefill_rate(seq)
         if seq.todo_resume is not None:
             seq.last_token = seq.todo_resume
             return
@@ -1336,6 +1449,44 @@ class InferenceEngine:
                     ps = self.spec.page_size
                     cached = (int(h.get("length", 0)) // ps) * ps
         return cached, max(0, int(prompt_tokens))
+
+    # -- mixed prefill+decode batching (docs/architecture.md) ----------------
+
+    def _mixed_on(self) -> bool:
+        """Mixed batching configured AND the executor carries a mixed
+        program (slice geometry > 0 plus a dispatch entrypoint)."""
+        if self._mixed_cfg is None:
+            return False
+        if int(getattr(self.executor, "mixed_prefill_slices", 0)) <= 0:
+            return False
+        if int(getattr(self.executor, "mixed_slice_tokens", 0)) <= 0:
+            return False
+        return (getattr(self.executor, "mixed_chunk_start", None)
+                is not None
+                or getattr(self.executor, "mixed_chunk", None) is not None)
+
+    def _mixed_work_waiting(self) -> bool:
+        """Any mid-prefill slot with slices left to run (whether or not
+        one is already riding the in-flight chunk): blocks speculative
+        decode-only dispatch so the reconcile can fuse them."""
+        if not self._mixed_on():
+            return False
+        return any(s is not None and not s.prefilled and s.todo_ids
+                   for s in self._slots)
+
+    def _mixed_applicable(self) -> bool:
+        """Dispatch a MIXED chunk this round: mixed batching is on,
+        decode rows are active (with no decode work the dedicated
+        prefill pipeline is strictly faster — full buckets, async
+        waves), and at least one mid-prefill slot has a dispatchable
+        slice."""
+        if not self._mixed_on():
+            return False
+        if not any(s is not None and s.prefilled for s in self._slots):
+            return False
+        return any(s is not None and not s.prefilled and s.todo_ids
+                   and s.first_handle is None and not s.mixed_pending
+                   for s in self._slots)
 
     def _has_scheduling_work(self) -> bool:
         """Anything that requires host-side scheduling before the next
@@ -1578,12 +1729,50 @@ class InferenceEngine:
             if box["err"] is not None:
                 raise box["err"]
             out = box["out"]
+        pf_first = None
+        if infl.pf is not None:
+            out, pf_first = out      # mixed chunk: (decode, slice firsts)
         for slot in range(self.spec.batch_size):
             seq = infl.seqs[slot]
             if seq is None or seq.slot != slot:
                 continue    # finished while the chunk was in flight
             self._commit_row(seq, out[slot], int(infl.budgets[slot]))
+        if infl.pf is not None:
+            self._finish_mixed_prefills(infl.pf, pf_first)
         self._set_gauges()
+
+    def _budget_chunk_rows(self, chunk: int, rows) -> Dict[int, int]:
+        """Shared eligibility + budgeting for chunk assembly
+        (_decode_once AND _mixed_once — the two must stay in lockstep
+        or the mixed path's token-equivalence contract breaks): reap
+        cancelled/length rows, back each survivor's budget with pages
+        (preempt-with-release when the pool can't), and return
+        seq.order → budget."""
+        budgets_by_order: Dict[int, int] = {}
+        for seq in rows:
+            if seq.slot is None:
+                continue  # shed by an earlier sequence's page allocation
+            if seq.handle.cancelled:
+                self._finish_active(seq, "cancelled")
+                continue
+            if seq.pos // self.spec.page_size >= self.spec.max_pages_per_seq:
+                self._finish_active(seq, "length")  # block table exhausted
+                continue
+            budget = self._budget_for(seq, chunk)
+            if not seq.prefilled:
+                # Joining row (decode path only): the resolve will
+                # commit the prefill-sampled token FIRST, so the row
+                # may emit one fewer (0 latches the row — harmless; its
+                # admission still completes at resolve).
+                budget = max(0, budget - 1)
+            if budget and not self._ensure_decode_pages(seq, budget):
+                # Pool exhausted even after shedding everyone else:
+                # requeue this one rather than truncating its output.
+                if seq.slot is not None:  # may have been shed already
+                    self._preempt(seq, release_pages=True)
+                continue
+            budgets_by_order[seq.order] = budget
+        return budgets_by_order
 
     def _decode_once(self) -> bool:
         B = self.spec.batch_size
@@ -1614,30 +1803,8 @@ class InferenceEngine:
         if not active and not joining:
             self._set_gauges()
             return False
-        budgets_by_order: Dict[int, int] = {}
-        for seq in list(active) + joining:
-            if seq.slot is None:
-                continue  # shed by an earlier sequence's page allocation
-            if seq.handle.cancelled:
-                self._finish_active(seq, "cancelled")
-                continue
-            if seq.pos // self.spec.page_size >= self.spec.max_pages_per_seq:
-                self._finish_active(seq, "length")  # block table exhausted
-                continue
-            budget = self._budget_for(seq, chunk)
-            if not seq.prefilled:
-                # Joining row: the resolve will commit the
-                # prefill-sampled token FIRST, so the row may emit one
-                # fewer (0 latches the row — harmless; its admission
-                # still completes at resolve).
-                budget = max(0, budget - 1)
-            if budget and not self._ensure_decode_pages(seq, budget):
-                # Pool exhausted even after shedding everyone else:
-                # requeue this one rather than truncating its output.
-                if seq.slot is not None:  # may have been shed already
-                    self._preempt(seq, release_pages=True)
-                continue
-            budgets_by_order[seq.order] = budget
+        budgets_by_order = self._budget_chunk_rows(chunk,
+                                                   list(active) + joining)
         active = [s for s in self._slots
                   if s is not None and s.prefilled]
         joining = [s for s in joining
@@ -1699,6 +1866,165 @@ class InferenceEngine:
             self._commit_row(seq, out[seq.slot], int(budgets[seq.slot]))
         self._set_gauges()
         return True
+
+    def _mixed_once(self) -> bool:
+        """Dispatch ONE mixed iteration: the active decode rows' chunk
+        plus up to ``mixed_batch.prefill_token_budget`` tokens of
+        pending prefill slices, fused into a single device program
+        (executor ``mixed_chunk_start`` / ``mixed_chunk``). This
+        replaces the "prefill program, then decode chunk" serialization
+        whenever both kinds of work coexist: decode rows keep emitting
+        every iteration and their prefill-induced stall is bounded by
+        the budget instead of the longest admitted prompt. Token
+        streams are identical to the unfused path — slices write the
+        same KV at the same positions, the final slice samples the same
+        first token, decode rows never read another sequence's pages.
+        """
+        B = self.spec.batch_size
+        chunk = max(1, getattr(self.executor, "chunk_size", 1))
+        chunk = min(chunk, self._admission_cap())
+        S = int(getattr(self.executor, "mixed_prefill_slices", 0))
+        T = int(getattr(self.executor, "mixed_slice_tokens", 0))
+        budget = int(self._mixed_cfg.prefill_token_budget)
+
+        # Decode rows: same eligibility/budgeting as _decode_once (no
+        # join rows — mixed iterations reconcile every cycle, so there
+        # is never an unresolved first_handle to join here).
+        budgets_by_order = self._budget_chunk_rows(
+            chunk, [s for s in self._slots
+                    if s is not None and s.prefilled])
+        active = [s for s in self._slots
+                  if s is not None and s.prefilled]
+
+        # Prefill slices, most urgent first — packed AFTER decode
+        # budgeting (its page allocation may shed a mid-prefill victim;
+        # the pack must see the post-shed state).
+        cands = [s for s in self._slots
+                 if s is not None and not s.prefilled and s.todo_ids
+                 and s.first_handle is None and not s.mixed_pending]
+        for s in list(cands):
+            if s.handle.cancelled:
+                self._finish_active(s, "cancelled")
+                cands.remove(s)
+        cands.sort(key=lambda s: s.sort_key())
+        pf_plan = []                 # (seq, slice tokens)
+        packed = 0
+        for seq in cands[:S]:
+            width = min(T, budget - packed)
+            if width <= 0:
+                break
+            sl = seq.todo_ids[:width]
+            pf_plan.append((seq, sl))
+            packed += len(sl)
+        if not pf_plan:
+            # Every candidate was shed/cancelled DURING decode
+            # budgeting (a page-pressure race — _mixed_applicable
+            # guaranteed one existed at entry): fall back to a plain
+            # chunk. _decode_once re-runs the budgeting pass, which is
+            # idempotent (pages already ensured, need <= 0) and rare
+            # enough that sharing budgets across the two paths isn't
+            # worth the coupling; packing BEFORE budgeting instead
+            # would reintroduce the stale-slice bug (a shed victim's
+            # todo_ids fold into its rebuild stream).
+            return self._decode_once()
+
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
+        temps = np.zeros(B, np.float32)
+        budgets = np.zeros(B, np.int32)
+        for seq in active:
+            i = seq.slot
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            block_tables[i] = seq.block_table
+            temps[i] = seq.req.temperature
+            budgets[i] = budgets_by_order.get(seq.order, 1)
+
+        pf = []
+        infl_pf = []
+        for seq, sl in pf_plan:
+            seq.handle.marks.setdefault("prefill_start",
+                                        time.perf_counter())
+            pf.append((seq.slot, sl, seq.todo_pos, seq.block_table,
+                       seq.req.temperature))
+            seq.todo_ids = seq.todo_ids[len(sl):]
+            seq.todo_pos += len(sl)
+            seq.pos = seq.todo_pos
+            seq.pf_tokens_run += len(sl)
+            seq.written_ids.extend(sl)
+            infl_pf.append((seq, len(sl), not seq.todo_ids))
+
+        if self._metrics:
+            self._metrics.mixed_step_decode_rows.labels(self.name).set(
+                len(active))
+            self._metrics.mixed_step_prefill_tokens.labels(
+                self.name).set(packed)
+            self._metrics.mixed_budget_utilization.labels(
+                self.name).set(packed / budget if budget else 0.0)
+
+        start_fn = getattr(self.executor, "mixed_chunk_start", None)
+        t0 = time.perf_counter()
+        if start_fn is not None:
+            with self._prof.span("engine.mixed_chunk",
+                                 active=len(active), chunk=chunk,
+                                 slices=len(pf), pf_tokens=packed):
+                handle = start_fn(tokens, positions, block_tables,
+                                  temps, budgets, pf)
+            self._note_prefill_dispatch(
+                packed, time.perf_counter() - t0,
+                decode_active=bool(active), fused=True)
+            _prefetch(getattr(handle, "out", None))
+            _prefetch(getattr(handle, "pf_first", None))
+            seqs = [None] * B
+            for seq in active:
+                seqs[seq.slot] = seq
+            for seq, _, _ in infl_pf:
+                seq.mixed_pending = True
+            self._chunk_inflight = _InflightChunk(handle, seqs, budgets,
+                                                  pf=infl_pf)
+            self._start_fetch(self._chunk_inflight)
+            self.steps += 1
+            self.mixed_steps += 1
+            self.mixed_prefill_tokens_total += packed
+            if self._metrics:
+                self._metrics.decode_steps.labels(self.name).inc()
+            return True
+        # Sync executor (echo): one blocking call, commit inline.
+        with self._prof.span("engine.mixed_chunk", active=len(active),
+                             chunk=chunk, slices=len(pf),
+                             pf_tokens=packed):
+            out, pf_first = self.executor.mixed_chunk(
+                tokens, positions, block_tables, temps, budgets, pf)
+        self._note_prefill_dispatch(
+            packed, time.perf_counter() - t0,
+            decode_active=bool(active), fused=True)
+        self.steps += 1
+        self.mixed_steps += 1
+        self.mixed_prefill_tokens_total += packed
+        if self._metrics:
+            self._metrics.decode_steps.labels(self.name).inc()
+        for seq in active:
+            if seq.slot is not None:
+                self._commit_row(seq, out[seq.slot],
+                                 int(budgets[seq.slot]))
+        self._finish_mixed_prefills(infl_pf, pf_first)
+        self._set_gauges()
+        return True
+
+    def _finish_mixed_prefills(self, pf, pf_first) -> None:
+        """Reconcile the prefill slices of a processed mixed chunk:
+        clear the in-flight latch and complete admissions whose FINAL
+        slice ran (their sampled first token is ``pf_first[i]``)."""
+        for i, (seq, _n, final) in enumerate(pf):
+            seq.mixed_pending = False
+            if seq.slot is None or seq.prefilled:
+                continue   # shed or superseded while in flight
+            if seq.handle.cancelled:
+                self._finish_active(seq, "cancelled")
+                continue
+            if final:
+                self._complete_prefill(seq, int(pf_first[i]))
 
     def _commit_token(self, seq: _Sequence, nxt: int) -> None:
         if nxt == self.spec.eos_id:
@@ -1909,8 +2235,20 @@ class InferenceEngine:
             "cached_conversations": cached,
             "stall_events": self.stall_events,
             "stall_ms_total": round(self.stall_ms_total, 1),
+            "prefill_stall_events": self.prefill_stall_events,
+            "prefill_stall_ms_total": round(self.prefill_stall_ms_total,
+                                            1),
+            "prefill_tps_ewma": (round(self.prefill_tps_ewma, 1)
+                                 if self.prefill_tps_ewma else None),
             "profile": self._prof.summary(),
         }
+        if self._mixed_cfg is not None:
+            out["mixed_batch"] = {
+                "steps": self.mixed_steps,
+                "prefill_tokens": self.mixed_prefill_tokens_total,
+                "prefill_token_budget":
+                    int(self._mixed_cfg.prefill_token_budget),
+            }
         if self._prefix_cache is not None:
             pc = self._prefix_cache.get_stats()
             total = self.prefix_hits + self.prefix_misses

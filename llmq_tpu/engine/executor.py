@@ -107,21 +107,41 @@ class EchoExecutor:
 
     def __init__(self, batch_size: int = 8, page_size: int = 16,
                  num_pages: int = 512, max_pages_per_seq: int = 32,
-                 eos_id: int = 2, chunk_size: int = 1) -> None:
+                 eos_id: int = 2, chunk_size: int = 1,
+                 mixed_prefill_slices: int = 2,
+                 mixed_slice_tokens: int = 64) -> None:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
         self.chunk_size = chunk_size
+        #: Mixed-batch geometry (engine packing limits; the echo backend
+        #: has no compiled program, so these are just caps).
+        self.mixed_prefill_slices = max(0, mixed_prefill_slices)
+        self.mixed_slice_tokens = max(0, mixed_slice_tokens)
         self._slot_prompt: Dict[int, List[int]] = {}
         self._slot_end: Dict[int, int] = {}   # absolute pos after prompt
         self._mu = threading.Lock()
+
+    def _register_prefill(self, slot: int, tokens: List[int],
+                          start_pos: int) -> List[int]:
+        """Register a prefill chunk for ``slot`` and return the slot's
+        ACCUMULATED prefill stream. A chunk contiguous with what the
+        slot already holds EXTENDS it (budgeted mixed-batch slices, or
+        a prefill finished across paths); anything else replaces —
+        a fresh admission or a resume re-registration."""
+        cur_end = self._slot_end.get(slot)
+        if cur_end is not None and cur_end == start_pos:
+            self._slot_prompt[slot].extend(tokens)
+        else:
+            self._slot_prompt[slot] = list(tokens)
+        self._slot_end[slot] = start_pos + len(tokens)
+        return self._slot_prompt[slot]
 
     def prefill(self, tokens: List[int], start_pos: int,
                 block_table: np.ndarray, temperature: float,
                 slot: int) -> int:
         with self._mu:
-            self._slot_prompt[slot] = list(tokens)
-            self._slot_end[slot] = start_pos + len(tokens)
-        return tokens[0] if tokens else self.spec.eos_id
+            stream = self._register_prefill(slot, list(tokens), start_pos)
+        return stream[0] if stream else self.spec.eos_id
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray,
@@ -156,6 +176,29 @@ class EchoExecutor:
             tok = nxt
         return out
 
+    def mixed_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                    block_tables: np.ndarray, temperatures: np.ndarray,
+                    budgets: np.ndarray, pf) -> tuple:
+        """Mixed-batch parity with the JAX ``_mixed_chunk`` program, so
+        the engine's budgeted scheduling path runs in CPU/queue-plane
+        tests and benches. ``pf``: one ``(slot, tokens, start_pos,
+        block_table, temperature)`` tuple per prefill slice (the block
+        table is unused here). Slice KV "writes" happen before the
+        decode steps, mirroring the fused program; returns
+        ``(out (B, K), pf_first (S,))`` where ``pf_first[i]`` is the
+        sampled next token as of slice i's end — meaningful to the
+        engine only for a sequence's FINAL slice."""
+        pf_first = np.full(len(pf), self.spec.eos_id, np.int32)
+        with self._mu:
+            for i, (slot, toks, start_pos, _bt, _temp) in enumerate(pf):
+                stream = self._register_prefill(slot, list(toks),
+                                                start_pos)
+                if stream:
+                    pf_first[i] = stream[0]
+        out = self.decode_chunk(tokens, positions, block_tables,
+                                temperatures, budgets)
+        return out, pf_first
+
     def release_slot(self, slot: int) -> None:
         with self._mu:
             self._slot_prompt.pop(slot, None)
@@ -186,6 +229,28 @@ class ChunkHandle:
     def fetch(self) -> np.ndarray:
         """Blocking host transfer of the chunk's sampled tokens."""
         return np.asarray(self.out)
+
+
+class MixedChunkHandle:
+    """In-flight MIXED chunk (decode rows + budgeted prefill slices in
+    one program): same carry surface as :class:`ChunkHandle` (tok/pos/
+    done are the decode rows' device-resident end state) plus
+    ``pf_first`` — the per-slice sampled next tokens the engine commits
+    for sequences whose FINAL slice rode this chunk."""
+
+    __slots__ = ("out", "tok", "pos", "done", "pf_first")
+
+    def __init__(self, out, tok, pos, done, pf_first) -> None:
+        self.out = out
+        self.tok = tok
+        self.pos = pos
+        self.done = done
+        self.pf_first = pf_first
+
+    def fetch(self) -> tuple:
+        """Blocking host transfer: ``(decode tokens (B, K),
+        slice first-tokens (S,))``."""
+        return np.asarray(self.out), np.asarray(self.pf_first)
 
 
 class JaxExecutor:
@@ -220,13 +285,15 @@ class JaxExecutor:
                  top_k: int = 0, top_p: float = 1.0, eos_id: int = 2,
                  cache_dtype=None, seed: int = 0,
                  chunk_size: int = 16, prefill_batch: int = 4,
+                 mixed_prefill_slices: int = 2,
+                 mixed_slice_tokens: int = 64,
                  mesh=None) -> None:
         import jax
         import jax.numpy as jnp
         from functools import partial
 
         from llmq_tpu.models.llama import (
-            forward_decode, forward_prefill, init_kv_pages)
+            forward_decode, forward_mixed, forward_prefill, init_kv_pages)
         from llmq_tpu.ops.sampling import sample_token
 
         import dataclasses as _dc
@@ -271,6 +338,14 @@ class JaxExecutor:
         #: per-sequence KV-write/attention kernels row-loop inside).
         self.prefill_batch = max(1, min(prefill_batch, batch_size))
         self.prefill_buckets = sorted(prefill_buckets or [32, 128, 512])
+        #: Mixed-batch program geometry: S slice rows × T tokens per
+        #: row fused into the decode chunk (0 disables — no mixed
+        #: program is built or compiled). See ``_mixed_chunk`` below.
+        self.mixed_prefill_slices = max(0, mixed_prefill_slices)
+        self.mixed_slice_tokens = max(0, mixed_slice_tokens)
+        if self.mixed_prefill_slices == 0 or self.mixed_slice_tokens == 0:
+            self.mixed_prefill_slices = 0
+            self.mixed_slice_tokens = 0
         if self._kv_shardings is not None:
             # Create the pool ALREADY sharded (out_shardings) — a 70B
             # pool materialized on one chip before resharding would OOM
@@ -302,9 +377,14 @@ class JaxExecutor:
             jit_chunk = partial(jax.jit, donate_argnums=(1,),
                                 out_shardings=(_repl, _repl, _repl,
                                                _repl, kvs))
+            # mixed_chunk returns (out, tok, pos, done, pf_first, cache).
+            jit_mixed = partial(jax.jit, donate_argnums=(1,),
+                                out_shardings=(_repl, _repl, _repl,
+                                               _repl, _repl, kvs))
         else:
             jit_step = partial(jax.jit, donate_argnums=(1,))
             jit_chunk = jit_step
+            jit_mixed = jit_step
 
         @jit_step
         def _prefill_step(params, cache, tokens, positions, lengths,
@@ -408,10 +488,87 @@ class JaxExecutor:
                 (jnp.int32(0), cache, tokens, positions, frozen0, out0))
             return out, tok, pos, frozen, cache
 
+        S, T = self.mixed_prefill_slices, self.mixed_slice_tokens
+        _mixed_chunk = None
+        if S > 0:
+
+            @jit_mixed
+            def _mixed_chunk(params, cache, tokens, positions,
+                             block_tables, temperatures, budgets, done_in,
+                             pf_tokens, pf_positions, pf_lengths,
+                             pf_block_tables, pf_temps, key):
+                """Token-budget MIXED chunk: one device program that
+                advances the decode rows up to K steps AND runs S
+                prefill slices of up to T tokens each over the shared
+                paged pool. Step 0 is the fused pass (forward_mixed:
+                slice KV writes ride the same layer traversal as the
+                decode rows, so the per-layer weight stream is paid
+                once for both); steps 1..K-1 are the plain decode body
+                with the same EOS/budget latching as ``_decode_chunk``.
+                The decode rows' prefill-induced stall is thereby
+                bounded by S·T tokens (the engine's
+                ``mixed_batch.prefill_token_budget``), not by the
+                longest admitted prompt.
+
+                Returns ``(out (B, K), tok, pos, done, pf_first (S,),
+                cache)`` — the decode tail carry is identical to
+                ``_decode_chunk``'s; ``pf_first[i]`` samples slice i's
+                last valid position (the admission first-token when the
+                slice is a sequence's final one; garbage the engine
+                ignores otherwise)."""
+                B = tokens.shape[0]
+                keys = jax.random.split(key, K + 1)
+                out = jnp.full((B, K), eos, jnp.int32)
+                frozen = done_in
+                active0 = (~frozen) & (budgets > 0)
+                dec_logits, pf_logits, cache = forward_mixed(
+                    params, cfg, tokens, positions, cache, block_tables,
+                    pf_tokens, pf_positions, pf_lengths, pf_block_tables,
+                    dec_active=active0)
+                idx = jnp.arange(pf_tokens.shape[0])
+                pf_first = sample_token(
+                    pf_logits[idx, pf_lengths - 1], keys[K],
+                    temperature=pf_temps, top_k=top_k, top_p=top_p)
+                nxt = sample_token(dec_logits, keys[0],
+                                   temperature=temperatures,
+                                   top_k=top_k, top_p=top_p)
+                emit = jnp.where(active0, nxt, eos).astype(jnp.int32)
+                out = out.at[:, 0].set(emit)
+                tok = jnp.where(active0, nxt.astype(jnp.int32), tokens)
+                pos = positions + active0.astype(jnp.int32)
+                frozen = frozen | (active0 & (nxt == eos))
+
+                def cond(st):
+                    j, _, _, _, fr, _ = st
+                    return (j < K) & jnp.any(~fr & (j < budgets))
+
+                def body(st):
+                    j, cache, tok, pos, fr, out = st
+                    active = (~fr) & (j < budgets)
+                    logits, cache = forward_decode(
+                        params, cfg, tok, pos, cache, block_tables,
+                        active=active)
+                    nxt = sample_token(logits, keys[j],
+                                       temperature=temperatures,
+                                       top_k=top_k, top_p=top_p)
+                    emit = jnp.where(active, nxt, eos).astype(jnp.int32)
+                    out = jax.lax.dynamic_update_slice(
+                        out, emit[:, None], (0, j))
+                    tok = jnp.where(active, nxt.astype(jnp.int32), tok)
+                    pos = pos + active.astype(jnp.int32)
+                    fr = fr | (active & (nxt == eos))
+                    return (j + 1, cache, tok, pos, fr, out)
+
+                _, cache, tok, pos, frozen, out = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(1), cache, tok, pos, frozen, out))
+                return out, tok, pos, frozen, pf_first, cache
+
         self._prefill_step = _prefill_step
         self._prefill_multi = _prefill_multi
         self._decode_step = _decode_step
         self._decode_chunk = _decode_chunk
+        self._mixed_chunk = _mixed_chunk
         #: AOT-compiled executables by program name (filled by warmup;
         #: call sites prefer these — the jit wrappers re-trace on first
         #: call, the executables don't).
@@ -499,6 +656,11 @@ class JaxExecutor:
                       cfg, self.spec, self.chunk_size, self.prefill_batch,
                       tuple(self.prefill_buckets), self._top_k,
                       self._top_p,
+                      # Mixed-batch geometry: (S, T) changes the mixed
+                      # program's shapes — artifacts must not collide
+                      # across budget/slice reconfigurations.
+                      (self.mixed_prefill_slices,
+                       self.mixed_slice_tokens),
                       jax.tree.map(lambda x: (x.shape, str(x.dtype)),
                                    self.params),
                       # Cache tree identity: bf16-KV and int8-KV lower
@@ -572,6 +734,15 @@ class JaxExecutor:
                          (p, c, sds((B,), i32), sds((B,), i32),
                           sds((B, MP), i32), sds((B,), f32),
                           sds((B,), i32), sds((B,), jnp.bool_), key)))
+        if self._mixed_chunk is not None:
+            S, T = self.mixed_prefill_slices, self.mixed_slice_tokens
+            jobs.append(("mixed_chunk", self._mixed_chunk,
+                         (p, c, sds((B,), i32), sds((B,), i32),
+                          sds((B, MP), i32), sds((B,), f32),
+                          sds((B,), i32), sds((B,), jnp.bool_),
+                          sds((S, T), i32), sds((S, T), i32),
+                          sds((S,), i32), sds((S, MP), i32),
+                          sds((S,), f32), key)))
 
         exp_dir = self._export_cache_dir()
         exp_key = self._export_cache_key() if exp_dir else None
@@ -660,6 +831,13 @@ class JaxExecutor:
         zbt = np.zeros((spec.batch_size, spec.max_pages_per_seq), np.int32)
         ztemp = np.zeros(spec.batch_size, np.float32)
         self.decode(zeros_b, zeros_b, zbt, ztemp)
+        if self._mixed_chunk is not None:
+            # Mixed-chunk smoke: one trash slice + 1-step decode
+            # budgets, all writes land on reserved page 0.
+            self.mixed_chunk_start(
+                zeros_b, zeros_b, zbt, ztemp,
+                np.ones(spec.batch_size, np.int32),
+                [(0, [1], 0, zbt[0], 0.0)]).fetch()
         if self.chunk_size > 1:
             self.decode_chunk(zeros_b, zeros_b, zbt, ztemp,
                               np.ones(spec.batch_size, np.int32))
@@ -862,6 +1040,52 @@ class JaxExecutor:
         h = self.decode_chunk_start(tokens, positions, block_tables,
                                     temperatures, budgets)
         return h.fetch()
+
+    def mixed_chunk_start(self, tokens, positions,
+                          block_tables: np.ndarray,
+                          temperatures: np.ndarray,
+                          budgets: np.ndarray,
+                          pf: List) -> "MixedChunkHandle":
+        """Dispatch one MIXED chunk (no host sync): the decode rows'
+        chunk plus up to ``mixed_prefill_slices`` budgeted prefill
+        slices in a single program. ``pf``: ``(slot, tokens, start_pos,
+        block_table, temperature)`` per slice, each ≤
+        ``mixed_slice_tokens`` tokens (``slot`` is engine bookkeeping —
+        the program addresses slices by block table). Unused slice rows
+        pad with one trash token against reserved page 0, exactly like
+        ``prefill_multi_async``."""
+        if self._mixed_chunk is None:
+            raise RuntimeError("mixed batching disabled for this executor")
+        jnp = self._jnp
+        S, T = self.mixed_prefill_slices, self.mixed_slice_tokens
+        assert 0 < len(pf) <= S, len(pf)
+        pf_toks = np.zeros((S, T), np.int32)
+        pf_poss = np.zeros((S, T), np.int32)
+        pf_lens = np.ones(S, np.int32)     # pad rows: 1 trash token → page 0
+        pf_bts = np.zeros((S, self.spec.max_pages_per_seq), np.int32)
+        pf_temps = np.zeros(S, np.float32)
+        for i, (_slot, t, sp, bt, temp) in enumerate(pf):
+            assert 0 < len(t) <= T, len(t)
+            pf_toks[i, :len(t)] = t
+            pf_poss[i] = np.minimum(sp + np.arange(T), sp + len(t) - 1)
+            pf_lens[i] = len(t)
+            pf_bts[i] = bt
+            pf_temps[i] = temp
+        fn = self._aot.get("mixed_chunk", self._mixed_chunk)
+        with annotate("mixed_chunk"):
+            out, tok, pos, done, pf_first, self.cache = fn(
+                self.params, self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(budgets, jnp.int32),
+                jnp.zeros(self.spec.batch_size, bool),
+                jnp.asarray(pf_toks), jnp.asarray(pf_poss),
+                jnp.asarray(pf_lens), jnp.asarray(pf_bts),
+                jnp.asarray(pf_temps),
+                self._next_key())
+        return MixedChunkHandle(out, tok, pos, done, pf_first)
 
     def gather_scalars(self, arrs: List) -> np.ndarray:
         """Fetch an admission wave's device scalars with overlapped

@@ -109,6 +109,33 @@ class QueueMetrics:
             f"{ns}_prefix_cache_pages",
             "KV pages currently held by the radix prefix cache",
             ["engine"], registry=registry)
+        # Mixed prefill+decode batching (docs/architecture.md "Mixed
+        # step"): per-iteration occupancy of the fused program, plus
+        # the decode-stall attribution histogram. ``path`` on the stall
+        # histogram is "mixed" (slices fused into the decode chunk —
+        # bounded by mixed_batch.prefill_token_budget) or "program"
+        # (dedicated prefill programs serializing with the chunk — the
+        # unfused path's unbounded stall).
+        self.mixed_step_decode_rows = Gauge(
+            f"{ns}_mixed_step_decode_rows",
+            "Decode rows in the most recent mixed iteration",
+            ["engine"], registry=registry)
+        self.mixed_step_prefill_tokens = Gauge(
+            f"{ns}_mixed_step_prefill_tokens",
+            "Prefill tokens fused into the most recent mixed iteration",
+            ["engine"], registry=registry)
+        self.mixed_budget_utilization = Gauge(
+            f"{ns}_mixed_budget_utilization",
+            "Fused prefill tokens / prefill_token_budget for the most "
+            "recent mixed iteration", ["engine"], registry=registry)
+        self.prefill_stall_ms = Histogram(
+            f"{ns}_prefill_stall_ms",
+            "Estimated milliseconds active decode rows stalled behind "
+            "one round of prefill dispatches",
+            ["engine", "path"],
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                     250, 500, 1000, 2500),
+            registry=registry)
         # Cluster serving plane (llmq_tpu/cluster/, docs/multihost.md):
         # ``reason`` is why the endpoint was chosen — "affinity" (the
         # conversation's prefix-holding replica), "spill" (affine

@@ -454,6 +454,133 @@ def forward_decode(
     return _logits(params, h), out_cache
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_mixed(
+    params: Params,
+    cfg: LlamaConfig,
+    dec_tokens: jnp.ndarray,        # (B,) int32 — decode rows' last tokens
+    dec_positions: jnp.ndarray,     # (B,) int32
+    kv_cache: KVCache,
+    dec_block_tables: jnp.ndarray,  # (B, max_pages)
+    pf_tokens: jnp.ndarray,         # (S, T) int32, right-padded slices
+    pf_positions: jnp.ndarray,      # (S, T) int32 absolute, contiguous/row
+    pf_lengths: jnp.ndarray,        # (S,) int32 — valid tokens per slice
+    pf_block_tables: jnp.ndarray,   # (S, max_pages)
+    dec_active: Optional[jnp.ndarray] = None,  # (B,) bool
+) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
+    """Fused mixed step (token-budget mixed batching): advance B decode
+    rows one token AND write S prefill slices (up to T tokens each) into
+    the shared paged pool in ONE traversal of the stacked layer weights.
+
+    This is the device program behind ``executor.mixed_batch``: the
+    per-layer weight reads — where an HBM-bound decode step spends its
+    bandwidth — are paid once for both the decode rows and the prefill
+    slice tokens, and the decode rows' stall behind prefill work is
+    bounded by T·S (the engine's ``prefill_token_budget``) instead of
+    the longest admitted prompt. Layout is ragged by construction:
+    decode rows and slice rows are separate sequences over the same
+    pool, so their KV writes are disjoint and need no ordering.
+
+    Row conventions are exactly :func:`forward_prefill`'s (contiguous
+    ``pf_positions`` per row, padding discarded past ``pf_lengths``,
+    padded rows point at reserved page 0) and
+    :func:`forward_decode`'s (``dec_active`` redirects finished rows'
+    writes to page 0). Returns
+    ``(dec_logits (B, V), pf_logits (S, T, V), cache)``.
+    """
+    B = dec_tokens.shape[0]
+    S, T = pf_tokens.shape
+    page_sz = kv_cache["k"].shape[2]
+
+    # Decode-row geometry (forward_decode).
+    h_d = embed_lookup(params["embed"], dec_tokens, cfg.dtype)   # (B, D)
+    cos_d, sin_d = rope_cos_sin(dec_positions[:, None], cfg.head_dim,
+                                cfg.rope_theta)
+    page_of = dec_block_tables[jnp.arange(B), dec_positions // page_sz]
+    if dec_active is not None:
+        page_of = jnp.where(dec_active, page_of, 0)
+    slot_of = dec_positions % page_sz
+    dec_seq_lens = dec_positions + 1
+
+    # Slice-row geometry (forward_prefill).
+    h_p = embed_lookup(params["embed"], pf_tokens, cfg.dtype)    # (S, T, D)
+    cos_p, sin_p = rope_cos_sin(pf_positions, cfg.head_dim, cfg.rope_theta)
+    pf_valid = (jnp.arange(T)[None, :] < pf_lengths[:, None])
+    pf_last_pos = jnp.max(jnp.where(pf_valid, pf_positions, -1), axis=1)
+    pf_seq_lens = pf_last_pos + 1
+
+    lp = params["layers"]
+    quant_kv = "k_scale" in kv_cache
+    k_pool, v_pool = kv_cache["k"], kv_cache["v"]
+    if quant_kv:
+        pools = (k_pool, v_pool, kv_cache["k_scale"], kv_cache["v_scale"])
+    for l in range(cfg.n_layers):
+        wq, wk, wv = (layer_slice(lp["wq"], l), layer_slice(lp["wk"], l),
+                      layer_slice(lp["wv"], l))
+        # Slice rows first (order is free — disjoint pages — but fixed
+        # for determinism): write their KV, attend over their history.
+        hn_p = rms_norm(h_p, lp["attn_norm"][l], cfg.norm_eps)
+        q_p = linear(hn_p, wq).reshape(S, T, cfg.n_heads, cfg.head_dim)
+        k_p = linear(hn_p, wk).reshape(S, T, cfg.n_kv_heads, cfg.head_dim)
+        v_p = linear(hn_p, wv).reshape(S, T, cfg.n_kv_heads, cfg.head_dim)
+        q_p = apply_rope(q_p, cos_p, sin_p)
+        k_p = apply_rope(k_p, cos_p, sin_p)
+        if quant_kv:
+            pools = paged_kv_write_prefill_q8(
+                pools, k_p, v_p, pf_block_tables, pf_positions,
+                pf_lengths, jnp.int32(l))
+            attn_p = dispatch_prefill_attention_q8(
+                q_p, pools, pf_block_tables, pf_positions, pf_seq_lens, l)
+        else:
+            k_pool, v_pool = paged_kv_write_prefill(
+                k_pool, v_pool, k_p, v_p, pf_block_tables, pf_positions,
+                pf_lengths, jnp.int32(l), enabled=cfg.pallas,
+                multi_ok=cfg.pallas_batched_prefill)
+            attn_p = dispatch_prefill_attention(
+                q_p, k_pool, v_pool, pf_block_tables, pf_positions,
+                pf_seq_lens, l, enabled=cfg.pallas,
+                multi_ok=cfg.pallas_batched_prefill)
+        h_p = h_p + linear(attn_p.reshape(S, T, -1),
+                           layer_slice(lp["wo"], l))
+        hn2_p = rms_norm(h_p, lp["mlp_norm"][l], cfg.norm_eps)
+        h_p = h_p + _mlp(hn2_p, layer_slice(lp["w_gate"], l),
+                         layer_slice(lp["w_up"], l),
+                         layer_slice(lp["w_down"], l))
+
+        # Decode rows, same layer — the weight tiles streamed for the
+        # slice rows above are what this half reuses.
+        hn_d = rms_norm(h_d, lp["attn_norm"][l], cfg.norm_eps)
+        q_d = linear(hn_d, wq).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        k_d = linear(hn_d, wk).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        v_d = linear(hn_d, wv).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        q_d = apply_rope(q_d, cos_d, sin_d)[:, 0]
+        k_d = apply_rope(k_d, cos_d, sin_d)[:, 0]
+        v_d = v_d[:, 0]
+        if quant_kv:
+            attn_d, pools = paged_decode_step_q8(
+                q_d, k_d, v_d, pools, dec_block_tables, dec_seq_lens,
+                page_of, slot_of, jnp.int32(l), enabled=cfg.pallas)
+        else:
+            attn_d, k_pool, v_pool = paged_decode_step(
+                q_d, k_d, v_d, k_pool, v_pool, dec_block_tables,
+                dec_seq_lens, page_of, slot_of, jnp.int32(l),
+                enabled=cfg.pallas)
+        h_d = h_d + linear(attn_d.reshape(B, -1), layer_slice(lp["wo"], l))
+        hn2_d = rms_norm(h_d, lp["mlp_norm"][l], cfg.norm_eps)
+        h_d = h_d + _mlp(hn2_d, layer_slice(lp["w_gate"], l),
+                         layer_slice(lp["w_up"], l),
+                         layer_slice(lp["w_down"], l))
+
+    h_d = rms_norm(h_d, params["final_norm"], cfg.norm_eps)
+    h_p = rms_norm(h_p, params["final_norm"], cfg.norm_eps)
+    if quant_kv:
+        out_cache = {"k": pools[0], "v": pools[1],
+                     "k_scale": pools[2], "v_scale": pools[3]}
+    else:
+        out_cache = {"k": k_pool, "v": v_pool}
+    return _logits(params, h_d), _logits(params, h_p), out_cache
+
+
 def _sp_forward_local(params: Params, tokens_local: jnp.ndarray,
                       cfg: LlamaConfig, axis_name: str) -> jnp.ndarray:
     """Per-device body of the sequence-parallel long-context forward
@@ -510,8 +637,10 @@ def forward_prefill_sp(params: Params, cfg: LlamaConfig,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from llmq_tpu.ops.ring_attention import shard_map_compat
+
     spec_t = P(None, axis_name)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         _partial(_sp_forward_local, cfg=cfg, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(), spec_t),

@@ -1,0 +1,12 @@
+"""Version-bridging aliases for the Pallas TPU API surface.
+
+jax ≥0.6 renamed ``pltpu.TPUCompilerParams`` to
+``pltpu.CompilerParams``; this image ships a 0.4.x jax where only the
+old name exists. Kernels import the alias from here so they trace on
+both.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

@@ -63,6 +63,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llmq_tpu.ops.pallas._compat import CompilerParams
+
 NEG_INF = -1e30
 
 _CONSUMED = 0   # SMEM state: fetches consumed so far (slot parity)
@@ -468,7 +470,7 @@ def fused_decode_attention_pallas(
                    jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
                    jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
         input_output_aliases={8: 1, 9: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
@@ -872,7 +874,7 @@ def fused_decode_attention_q8_pallas(
                    jax.ShapeDtypeStruct(ks_pool.shape, ks_pool.dtype),
                    jax.ShapeDtypeStruct(vs_pool.shape, vs_pool.dtype)],
         input_output_aliases={10: 1, 11: 2, 12: 3, 13: 4},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),

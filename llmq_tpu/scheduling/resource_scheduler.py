@@ -169,6 +169,15 @@ class ResourceScheduler:
         #: the serving entrypoint. See :meth:`set_prefill_estimator`.
         self._prefill_estimator: Optional[
             Callable[[Dict], "tuple[int, int]"]] = None
+        #: Learned prefill throughput (tokens/s EWMA). With mixed
+        #: batching the effective rate is the BUDGETED one — prefill
+        #: tokens ride the decode chunk at mixed_batch.
+        #: prefill_token_budget per iteration, not the dedicated
+        #: program's burst rate — so the scheduler learns it from
+        #: observations (InferenceEngine.on_prefill_observed feeds
+        #: :meth:`observe_prefill`) instead of assuming a static figure.
+        self._prefill_tps: Optional[float] = None
+        self._prefill_observations = 0
 
     # -- cache-aware admission (prefix cache) --------------------------------
 
@@ -208,6 +217,36 @@ class ResourceScheduler:
         # knows its own token count better than the estimator does).
         amounts[ResourceType.TOKENS] = max(1.0, tok * (new / total))
         return amounts
+
+    def observe_prefill(self, tokens: int, seconds: float) -> None:
+        """Record one completed prefill (``tokens`` run in ``seconds``)
+        into the learned-rate EWMA. Bind this to
+        ``InferenceEngine.on_prefill_observed`` so admission sizing
+        tracks the rate the serving geometry ACTUALLY sustains —
+        budgeted mixed-batch slices included."""
+        if tokens <= 0 or seconds <= 0:
+            return
+        rate = tokens / seconds
+        with self._mu:
+            if self._prefill_tps is None:
+                self._prefill_tps = rate
+            else:
+                self._prefill_tps = 0.8 * self._prefill_tps + 0.2 * rate
+            self._prefill_observations += 1
+
+    def prefill_eta_ms(self, new_tokens: int) -> Optional[float]:
+        """Expected prefill milliseconds for ``new_tokens`` uncached
+        tokens at the learned rate. Zero tokens cost 0.0 regardless of
+        observations (a fully-cached prompt's cost is known, not
+        unknown); a positive amount returns None until the first
+        observation lands."""
+        if new_tokens <= 0:
+            return 0.0
+        with self._mu:
+            tps = self._prefill_tps
+        if not tps:
+            return None
+        return new_tokens / tps * 1e3
 
     # -- registry (:138-162) -------------------------------------------------
 
@@ -500,5 +539,16 @@ class ResourceScheduler:
                 "avg_load": (
                     sum(r.load for r in self._resources.values())
                     / len(self._resources) if self._resources else 0.0),
+                "prefill_tokens_per_s": (
+                    round(self._prefill_tps, 1)
+                    if self._prefill_tps else None),
+                "prefill_observations": self._prefill_observations,
+                # Operator-facing ETA at a canonical size (1k tokens):
+                # what one full-bucket prompt costs at the learned
+                # (budgeted, under mixed batching) rate. _mu is an
+                # RLock, so the helper's own acquire is reentrant.
+                "prefill_eta_ms_per_1k": (
+                    round(self.prefill_eta_ms(1000), 1)
+                    if self._prefill_tps else None),
                 "topology": self.topology.to_dict() if self.topology else None,
             }

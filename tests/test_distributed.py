@@ -68,6 +68,7 @@ def _clean_env():
     return env
 
 
+@pytest.mark.requires_tpu
 @pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
                     reason="multi-process test disabled")
 def test_two_process_rendezvous_and_collective(tmp_path):
@@ -185,6 +186,7 @@ print(f"proc {{jax.process_index()}} TP-forward OK", flush=True)
 """
 
 
+@pytest.mark.requires_tpu
 @pytest.mark.skipif(os.environ.get("LLMQ_SKIP_MULTIPROC") == "1",
                     reason="multi-process test disabled")
 def test_two_process_tensor_parallel_forward(tmp_path):

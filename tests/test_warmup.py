@@ -34,7 +34,8 @@ class TestWarmup:
         # present (a spec/signature drift would leave _aot empty).
         assert set(ex._aot) == {"prefill_b16", "prefill_b32",
                                 "prefill_multi_b16", "prefill_multi_b32",
-                                "decode", "decode_chunk"}, set(ex._aot)
+                                "decode", "decode_chunk",
+                                "mixed_chunk"}, set(ex._aot)
 
         # Serving goes through the executables and matches the jit path.
         bt = np.zeros((4, ex.spec.max_pages_per_seq), np.int32)
@@ -117,7 +118,7 @@ class TestWarmup:
 
         ex = build()
         ex.warmup()
-        assert len(list(tmp_path.glob("*.jaxexp"))) == 6   # all exported
+        assert len(list(tmp_path.glob("*.jaxexp"))) == 7   # all exported
 
         bt = np.zeros((4, ex.spec.max_pages_per_seq), np.int32)
         bt[0, :2] = [1, 2]
