@@ -100,6 +100,17 @@ class App:
             if cfg.executor.backend == "jax":
                 self._register_chip_resources()
 
+        # Engine crash supervisor (engine/supervisor.py,
+        # docs/robustness.md): detects a dead engine thread, fails the
+        # in-flight handles over to the worker retry path (WAL
+        # at-least-once, completions deduped) and restarts the loop.
+        self.supervisor = None
+        if self.engine is not None and cfg.executor.supervisor.enabled:
+            from llmq_tpu.engine.supervisor import EngineSupervisor
+            self.supervisor = EngineSupervisor(
+                self.engine, config=cfg.executor.supervisor,
+                enable_metrics=cfg.queue.enable_metrics)
+
         # Cluster serving plane (llmq_tpu/cluster/, docs/multihost.md):
         # a non-empty ``cluster.peers`` builds the replica-set router
         # over THIS process's LoadBalancer — the same instance the API
@@ -426,6 +437,8 @@ class App:
             self.load_balancer.start()
         if self.engine is not None:
             self.engine.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
         for w in self.workers:
             w.start()
         if self.autoscaler is not None:
@@ -443,6 +456,10 @@ class App:
     def stop(self) -> None:
         """Shutdown cascade mirroring cmd/server/main.go:109-118."""
         log.info("shutting down ...")
+        if self.supervisor is not None:
+            # BEFORE the engine stops: a supervisor that outlives the
+            # deliberate engine.stop() would "recover" it as a crash.
+            self.supervisor.stop()
         if getattr(self, "_hb_stop", None) is not None:
             self._hb_stop.set()
         if self.api is not None:
@@ -517,6 +534,11 @@ def _load(args) -> Config:
     # flight recorder before any component records a stage event.
     from llmq_tpu import observability
     observability.configure(cfg.observability)
+    # Chaos plane (docs/robustness.md): armed ONLY when
+    # chaos.enabled is true — disabled, every fault point is a single
+    # attribute check.
+    from llmq_tpu import chaos
+    chaos.configure(cfg.chaos)
     _maybe_join_cluster()
     return cfg
 

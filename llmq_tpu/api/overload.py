@@ -1,0 +1,151 @@
+"""Adaptive overload shedding at the API admission edge
+(docs/robustness.md).
+
+Slice-Level Scheduling (arXiv:2406.13511)'s core observation applies
+one layer up: once the backlog exceeds what the engine can serve
+within the SLA, ACCEPTING more work makes every queued request later —
+the only latency-preserving move is to reject at the edge, explicitly,
+with a Retry-After the client can act on. Three checks, in cost order:
+
+1. **engine down** → 503: the serving plane is restarting (engine
+   supervisor) or gone; queueing behind a dead engine just converts
+   client timeouts into queue debt. Retry-After ≈ the supervisor's
+   restart latency.
+2. **queue backlog** → 429: total pending across this manager's queues
+   crossed ``overload.queue_depth_limit`` (default 90% of
+   ``queue.max_queue_size`` — shed BEFORE the hard queue-full 503, so
+   well-behaved clients back off first).
+3. **deadline headroom** → 429: the measured per-tier wait estimate
+   plus the ResourceScheduler's learned prefill ETA already exceeds
+   the request's own ``timeout`` — the request CANNOT meet its SLA, so
+   admitting it only to time it out later wastes a dispatch + prefill.
+
+Every shed is labeled in ``requests_shed_total{reason,code}`` and
+carries a ``Retry-After`` header + body field. ``overload.enabled:
+false`` is a hard off-switch: the shedder is never constructed and the
+submit path is byte-identical to pre-shedding behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from llmq_tpu.core.types import Message
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("overload")
+
+#: Crude prompt-size estimate when only text is available (the
+#: tokenizer must not run on the admission hot path).
+_CHARS_PER_TOKEN = 4.0
+
+
+class OverloadShedder:
+    def __init__(self, config, queue_config=None, *, engine=None,
+                 resource_scheduler=None,
+                 enable_metrics: bool = True) -> None:
+        #: core.config.OverloadConfig (or same-shaped object).
+        self.config = config
+        self.engine = engine
+        self.resource_scheduler = resource_scheduler
+        limit = int(getattr(config, "queue_depth_limit", 0) or 0)
+        if limit <= 0 and queue_config is not None:
+            limit = int(0.9 * getattr(queue_config, "max_queue_size",
+                                      10000))
+        self.queue_depth_limit = limit
+        self._mu = threading.Lock()
+        self.shed_counts = {"backlog": 0, "sla": 0, "engine_down": 0}
+        self._metrics = None
+        if enable_metrics:
+            try:
+                from llmq_tpu.metrics.registry import get_metrics
+                self._metrics = get_metrics()
+            except Exception:  # noqa: BLE001
+                self._metrics = None
+
+    # -- the gate ------------------------------------------------------------
+
+    def admit(self, msg: Message, manager=None,
+              estimated_wait: float = 0.0) -> None:
+        """Raise ``ApiError`` (429/503, with ``retry_after``) when the
+        request should be shed; return silently to admit. ``manager``
+        None skips the backlog check (the SSE path has its own
+        stream-level gates)."""
+        retry_base = max(0.5, float(getattr(self.config, "retry_after",
+                                            1.0)))
+        eng = self.engine
+        if eng is not None and not getattr(eng, "running", True):
+            self._shed("engine_down", 503, retry_base,
+                       "engine not running on this host (restarting or "
+                       "failed) — retry or use another replica")
+        if manager is not None and self.queue_depth_limit > 0:
+            try:
+                depth = manager.total_pending()
+            except Exception:  # noqa: BLE001 — advisory check
+                depth = 0
+            if depth >= self.queue_depth_limit:
+                self._shed(
+                    "backlog", 429,
+                    max(retry_base, float(estimated_wait)),
+                    f"queue backlog too deep ({depth} pending >= "
+                    f"{self.queue_depth_limit})")
+        headroom = float(getattr(self.config, "deadline_headroom", 0.0))
+        if headroom > 0 and msg.timeout and msg.timeout > 0:
+            eta = float(estimated_wait) + self._prefill_eta_s(msg)
+            if eta > msg.timeout * headroom:
+                self._shed(
+                    "sla", 429,
+                    max(retry_base, eta - float(msg.timeout)),
+                    f"cannot meet deadline: estimated {eta:.1f}s to "
+                    f"first service exceeds the request's "
+                    f"{msg.timeout:.1f}s budget")
+
+    def _prefill_eta_s(self, msg: Message) -> float:
+        """Learned prefill cost for this prompt (seconds); 0 until the
+        ResourceScheduler has observations (cold start must not shed)."""
+        rs = self.resource_scheduler
+        if rs is None:
+            return 0.0
+        est_tokens = int(len(msg.content or "") / _CHARS_PER_TOKEN)
+        if est_tokens <= 0:
+            return 0.0
+        try:
+            eta_ms = rs.prefill_eta_ms(est_tokens)
+        except Exception:  # noqa: BLE001 — advisory
+            return 0.0
+        return (eta_ms or 0.0) / 1e3
+
+    def _shed(self, reason: str, code: int, retry_after: float,
+              detail: str) -> None:
+        from llmq_tpu.api.server import ApiError
+        with self._mu:
+            # HTTP handler threads shed concurrently during exactly the
+            # bursts these counts exist to diagnose.
+            self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+        if self._metrics:
+            self._metrics.requests_shed.labels(reason, str(code)).inc()
+        log.warning("shedding request (%s → %d, retry in %.1fs): %s",
+                    reason, code, retry_after, detail)
+        raise ApiError(code, f"overloaded ({reason}): {detail}",
+                       retry_after=retry_after)
+
+    def get_stats(self) -> dict:
+        with self._mu:
+            return {"queue_depth_limit": self.queue_depth_limit,
+                    "shed": dict(self.shed_counts)}
+
+
+def build_shedder(config, *, engine=None,
+                  resource_scheduler=None) -> Optional[OverloadShedder]:
+    """The wiring seam: an :class:`OverloadShedder` from a full
+    ``core.config.Config``, or None when ``overload.enabled`` is false
+    (the hard off-switch — no admission checks exist at all)."""
+    ocfg = getattr(config, "overload", None)
+    if ocfg is None or not getattr(ocfg, "enabled", False):
+        return None
+    return OverloadShedder(
+        ocfg, getattr(config, "queue", None), engine=engine,
+        resource_scheduler=resource_scheduler,
+        enable_metrics=getattr(getattr(config, "queue", None),
+                               "enable_metrics", True))

@@ -82,10 +82,17 @@ class _SSEStream:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None) -> None:
         super().__init__(message)
         self.status = status
         self.message = message
+        #: Seconds the client should wait before retrying (overload
+        #: shedding, docs/robustness.md). Surfaces as BOTH a
+        #: ``Retry-After`` response header and a ``retry_after`` body
+        #: field (dispatch() callers see the body; HTTP clients the
+        #: header).
+        self.retry_after = retry_after
 
 
 class _Request:
@@ -168,6 +175,12 @@ class ApiServer:
         # bound (satellite fix; see _acquire_stream_slot).
         self._stream_mu = threading.Lock()
         self._active_streams = 0
+        # Overload shedding (api/overload.py, docs/robustness.md):
+        # None when overload.enabled is false — the submit path then
+        # runs exactly the pre-shedding code.
+        from llmq_tpu.api.overload import build_shedder
+        self.shedder = build_shedder(self.config, engine=engine,
+                                     resource_scheduler=resource_scheduler)
         self._setup_routes()
 
     # -- SSE admission -------------------------------------------------------
@@ -273,7 +286,10 @@ class ApiServer:
             try:
                 status, payload = handler(req)
             except ApiError as e:
-                return e.status, {"error": e.message}, "application/json"
+                body: Dict[str, Any] = {"error": e.message}
+                if e.retry_after is not None:
+                    body["retry_after"] = round(float(e.retry_after), 3)
+                return e.status, body, "application/json"
             except QueueNotFoundError as e:
                 return 404, {"error": str(e)}, "application/json"
             except QueueFullError as e:
@@ -357,6 +373,11 @@ class ApiServer:
                      for k in ("word_count", "char_count", "sentiment",
                                "is_question") if k in msg.metadata})
         mgr = self._manager()
+        if self.shedder is not None:
+            # Shed BEFORE the enqueued stamp: a rejected request never
+            # entered the queue plane, and its 429/503 + Retry-After is
+            # its complete, explicit outcome.
+            self.shedder.admit(msg, mgr, self.estimate_wait(msg.priority))
         # Stamp BEFORE the push: a near-idle worker can pop and stamp
         # "scheduled" before this thread resumes, and a scheduled <
         # enqueued inversion would drop the queue_wait sample exactly
@@ -450,6 +471,11 @@ class ApiServer:
             msg = Message.from_dict(data)
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"invalid message: {e}") from None
+        if self.shedder is not None:
+            # Engine-down / SLA shedding for streams (no manager: the
+            # stream cap + backlog gates below are the queue-side
+            # equivalents on this path).
+            self.shedder.admit(msg, None, 0.0)
         # Admission: the SSE path bypasses queue admission entirely, so
         # it carries its own gate (429 stream cap / 503 backlog shed).
         self._acquire_stream_slot()
@@ -1075,6 +1101,15 @@ class ApiServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if isinstance(payload, dict) and "retry_after" in payload:
+                    # Overload shed (docs/robustness.md): the standard
+                    # header form (integer seconds, rounded up — a
+                    # too-early retry is the thing being prevented).
+                    import math
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, math.ceil(float(
+                            payload["retry_after"])))))
                 self._cors_headers()
                 self.end_headers()
                 self.wfile.write(data)

@@ -1,0 +1,31 @@
+"""Chaos plane: deterministic fault injection + scenario invariants.
+
+``chaos.enabled: true`` (config, default FALSE) arms seeded fault
+rules at named points compiled into the real code paths — transport
+request/probe, engine step, simulated HBM allocation failure, WAL
+append/fsync — so the stack's durability claims (WAL redelivery, DLQ
+backstop, circuit breakers, failover, supervisor restart) are
+falsifiable under test instead of asserted. Disabled, every fault
+point is a single attribute check (the hard off-switch).
+
+    from llmq_tpu import chaos
+    chaos.fault("transport.request", endpoint=ep.id)
+
+See docs/robustness.md for the fault-point table, scenario recipes and
+the seed-reproduction workflow; tests/test_chaos.py is the harness.
+"""
+
+from llmq_tpu.chaos.injector import (  # noqa: F401
+    VALID_KINDS,
+    ChaosFault,
+    ChaosOSError,
+    ChaosPartialResponse,
+    ChaosTimeout,
+    EngineCrash,
+    FaultInjector,
+    FaultRule,
+    configure,
+    fault,
+    get_injector,
+)
+from llmq_tpu.chaos.invariants import InvariantChecker  # noqa: F401

@@ -1,0 +1,288 @@
+"""Deterministic, seeded fault injection (docs/robustness.md).
+
+The durability/failover machinery across the stack — WAL redelivery,
+DLQ backstop, cluster failover, drain, the engine supervisor — exists
+to survive faults that unit tests never actually produce. This module
+makes those faults producible ON DEMAND, deterministically, at named
+fault points compiled into the real code paths:
+
+=========================  =============================================
+fault point                seam
+=========================  =============================================
+``transport.request``      HttpEngineClient.process_fn, before dispatch
+``transport.probe``        HttpEngineClient.healthy()
+``engine.step``            InferenceEngine.step(), before scheduling
+``engine.hbm_alloc``       InferenceEngine._alloc_pages (simulated HBM
+                           allocation failure — request stays pending)
+``wal.append``             QueueWAL.append, before the journal write
+``wal.fsync``              QueueWAL fsync sites (append window + close)
+=========================  =============================================
+
+Usage contract for an instrumented seam is one line::
+
+    from llmq_tpu import chaos
+    chaos.fault("transport.request", endpoint=ep.id)
+
+which returns after ONE module-attribute check when chaos is disabled
+(the ``chaos.enabled: false`` hard off-switch — the default), and
+otherwise consults the configured rules.
+
+Determinism: every rule owns a :class:`random.Random` seeded from
+``(chaos.seed, rule index)``, and probability draws consume that stream
+in call order — so a scenario replays exactly given the same seed,
+rules and call sequence. No global RNG is ever touched.
+
+Fault kinds:
+
+- ``error``    → raise :class:`ChaosFault` (a RuntimeError: replica
+  failure — the cluster router's failover path, the worker retry path)
+- ``timeout``  → raise :class:`ChaosTimeout` (a TimeoutError: deadline
+  miss — must NOT fail over and must NOT feed circuit breakers)
+- ``partial``  → raise :class:`ChaosPartialResponse` (TimeoutError
+  subclass: the request may have executed remotely but the response was
+  lost — the indeterminate outcome, owned by the retry path)
+- ``oserror``  → raise :class:`ChaosOSError` (WAL write/fsync faults)
+- ``latency``  → sleep ``latency_ms`` then continue normally
+- ``crash``    → raise :class:`EngineCrash` (BaseException — sails past
+  ``except Exception`` handlers and KILLS the engine loop thread; the
+  supervisor's restart path is the handler)
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("chaos")
+
+VALID_KINDS = ("error", "timeout", "partial", "oserror", "latency",
+               "crash")
+
+
+class ChaosFault(RuntimeError):
+    """Injected replica/engine failure (retryable, fails over)."""
+
+    def __init__(self, point: str, seq: int) -> None:
+        super().__init__(f"chaos: injected fault at {point} (#{seq})")
+        self.point = point
+        self.seq = seq
+
+
+class ChaosTimeout(TimeoutError):
+    """Injected deadline miss (never fails over, never trips breakers)."""
+
+    def __init__(self, point: str, seq: int) -> None:
+        super().__init__(f"chaos: injected timeout at {point} (#{seq})")
+        self.point = point
+        self.seq = seq
+
+
+class ChaosPartialResponse(ChaosTimeout):
+    """Injected lost-response: the work may have happened remotely.
+    A TimeoutError subclass so every indeterminate-outcome guard
+    (cluster router: no failover; worker: timeout/retry path) applies."""
+
+
+class ChaosOSError(OSError):
+    """Injected filesystem fault (WAL write/fsync)."""
+
+    def __init__(self, point: str, seq: int) -> None:
+        super().__init__(f"chaos: injected I/O error at {point} (#{seq})")
+        self.point = point
+        self.seq = seq
+
+
+class EngineCrash(BaseException):
+    """Injected engine-thread death. Deliberately NOT an Exception:
+    the engine loop's ``except Exception`` must not absorb it — the
+    thread dies and the supervisor (engine/supervisor.py) takes over."""
+
+    def __init__(self, point: str, seq: int) -> None:
+        super().__init__(f"chaos: injected engine crash at {point} "
+                         f"(#{seq})")
+        self.point = point
+        self.seq = seq
+
+
+@dataclass
+class FaultRule:
+    """One configured fault: where, what, how often, how many times."""
+
+    point: str                    # exact name or fnmatch pattern ("transport.*")
+    kind: str = "error"
+    probability: float = 1.0      # per-eligible-call firing probability
+    times: int = 0                # max injections; 0 = unlimited
+    #: Eligible calls to let through untouched before the rule arms —
+    #: the deterministic way to crash MID-scenario ("kill the engine on
+    #: its 10th step") instead of on first contact.
+    after: int = 0
+    latency_ms: float = 0.0       # for kind="latency"
+    #: Context equality filters: {"endpoint": "host:8081"} fires only
+    #: when the seam's ctx carries that exact value.
+    match: Dict[str, str] = field(default_factory=dict)
+    injected: int = 0
+    seen: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in VALID_KINDS:
+            raise ValueError(f"unknown chaos fault kind {self.kind!r}; "
+                             f"valid: {VALID_KINDS}")
+
+    def matches(self, point: str, ctx: Dict) -> bool:
+        if not (self.point == point or fnmatch.fnmatch(point, self.point)):
+            return False
+        for k, v in self.match.items():
+            if str(ctx.get(k)) != str(v):
+                return False
+        return True
+
+
+class FaultInjector:
+    """Seeded rule engine behind the module-level :func:`fault` seam."""
+
+    def __init__(self, seed: int = 0,
+                 rules: Optional[List[Dict]] = None) -> None:
+        self.seed = int(seed)
+        self._mu = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self._rngs: List[random.Random] = []
+        #: (point, kind) → injections fired; engine-local so tests and
+        #: benches with prometheus disabled can still read them.
+        self.injected: Dict[Tuple[str, str], int] = {}
+        self._metrics = None
+        for r in rules or []:
+            self.add_rule(**r)
+
+    def add_rule(self, point: str, kind: str = "error",
+                 probability: float = 1.0, times: int = 0,
+                 after: int = 0, latency_ms: float = 0.0,
+                 match: Optional[Dict] = None, **extra_match) -> FaultRule:
+        """Register one rule (config load and programmatic tests share
+        this path). Keyword args beyond the rule fields become context
+        equality filters, e.g. ``add_rule("transport.request",
+        endpoint="host:8081")``."""
+        m = dict(match or {})
+        m.update(extra_match)
+        rule = FaultRule(point=point, kind=kind,
+                         probability=float(probability), times=int(times),
+                         after=int(after),
+                         latency_ms=float(latency_ms), match=m)
+        with self._mu:
+            self._rules.append(rule)
+            # Per-rule stream: a rule's draws depend only on (seed, its
+            # index, its own call order) — adding rule B never perturbs
+            # rule A's firing pattern.
+            self._rngs.append(
+                random.Random(self.seed * 1000003 + len(self._rules)))
+        return rule
+
+    def clear(self) -> None:
+        with self._mu:
+            self._rules = []
+            self._rngs = []
+
+    def _arm(self, point: str, ctx: Dict) -> Optional[FaultRule]:
+        """Pick the first matching rule that fires, under the lock (the
+        seeded draw and the times-counter must be atomic)."""
+        with self._mu:
+            for rule, rng in zip(self._rules, self._rngs):
+                if not rule.matches(point, ctx):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.times and rule.injected >= rule.times:
+                    continue
+                if rule.probability < 1.0 and rng.random() > rule.probability:
+                    continue
+                rule.injected += 1
+                key = (point, rule.kind)
+                self.injected[key] = self.injected.get(key, 0) + 1
+                self._count_metric(point, rule.kind)
+                return rule
+        return None
+
+    def _count_metric(self, point: str, kind: str) -> None:
+        try:
+            if self._metrics is None:
+                from llmq_tpu.metrics.registry import get_metrics
+                self._metrics = get_metrics()
+            self._metrics.chaos_injected.labels(point, kind).inc()
+        except Exception:  # noqa: BLE001 — injection must not couple
+            pass           # to the metrics plane
+
+    def fault(self, point: str, **ctx) -> None:
+        """Evaluate ``point`` against the rules; raise/sleep per the
+        first rule that fires, else return."""
+        rule = self._arm(point, ctx)
+        if rule is None:
+            return
+        seq = self.injected[(point, rule.kind)]
+        log.warning("chaos: injecting %s at %s (#%d)", rule.kind, point,
+                    seq, extra={"fields": {"point": point,
+                                           "kind": rule.kind}})
+        if rule.kind == "latency":
+            time.sleep(max(0.0, rule.latency_ms) / 1e3)
+            return
+        if rule.kind == "timeout":
+            raise ChaosTimeout(point, seq)
+        if rule.kind == "partial":
+            raise ChaosPartialResponse(point, seq)
+        if rule.kind == "oserror":
+            raise ChaosOSError(point, seq)
+        if rule.kind == "crash":
+            raise EngineCrash(point, seq)
+        raise ChaosFault(point, seq)
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            return {
+                "seed": self.seed,
+                "rules": [{"point": r.point, "kind": r.kind,
+                           "probability": r.probability,
+                           "times": r.times, "injected": r.injected}
+                          for r in self._rules],
+                "injected": {f"{p}:{k}": n
+                             for (p, k), n in self.injected.items()},
+            }
+
+
+#: Process-global injector. None ⇔ chaos disabled: the hot-path
+#: :func:`fault` then returns after one attribute check — the hard
+#: off-switch's mechanism (identical to pre-chaos behavior).
+_injector: Optional[FaultInjector] = None
+
+
+def configure(cfg) -> Optional[FaultInjector]:
+    """Install the process injector from a ``core.config.ChaosConfig``
+    (or anything with ``enabled``/``seed``/``faults`` fields). Disabled
+    or None tears the injector down."""
+    global _injector
+    if cfg is None or not getattr(cfg, "enabled", False):
+        _injector = None
+        return None
+    inj = FaultInjector(seed=int(getattr(cfg, "seed", 0) or 0),
+                        rules=list(getattr(cfg, "faults", []) or []))
+    _injector = inj
+    log.warning("chaos plane ENABLED: seed=%d, %d rule(s)", inj.seed,
+                len(inj._rules))
+    return inj
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _injector
+
+
+def fault(point: str, **ctx) -> None:
+    """The one-line seam instrumented code calls. No-op (one attribute
+    check) when chaos is disabled."""
+    inj = _injector
+    if inj is None:
+        return
+    inj.fault(point, **ctx)

@@ -1,0 +1,132 @@
+"""Invariant checker for chaos scenarios (docs/robustness.md).
+
+The chaos harness (tests/test_chaos.py) drives seeded fault scenarios
+— replica kills, flapping transports, WAL fsync faults, overload
+bursts — and this checker asserts the three properties the whole
+robustness story rests on:
+
+1. **Zero message loss** — every submitted request reaches exactly one
+   terminal outcome: completed, explicitly failed/shed (the client was
+   told), or parked in the DLQ (an operator can requeue it). A request
+   that simply vanishes is the one unacceptable outcome.
+2. **Zero duplicate completions** — at-least-once redelivery (WAL,
+   worker retry, failover) may re-EXECUTE, but a request must never be
+   COMPLETED twice: the second completion would double-deliver a
+   response the client already consumed.
+3. **Monotone token streams** — a streaming consumer sees an
+   append-only token sequence that is a prefix of the final result; a
+   crash/restart must never replay tokens into a live stream.
+
+The checker is a passive event sink (thread-safe — engine callbacks
+fire from engine threads) with one terminal ``check()`` that raises
+``AssertionError`` carrying every violation at once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class InvariantChecker:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._submitted: List[str] = []
+        #: request id → list of terminal outcomes observed
+        #: ("completed" | "failed" | "shed" | "dead_lettered").
+        self._terminal: Dict[str, List[str]] = {}
+        #: request id → tokens observed through the streaming callback,
+        #: in arrival order.
+        self._streams: Dict[str, List[int]] = {}
+        #: request id → final result token list (when known).
+        self._results: Dict[str, List[int]] = {}
+
+    # -- event sinks ---------------------------------------------------------
+
+    def submitted(self, request_id: str) -> None:
+        with self._mu:
+            self._submitted.append(request_id)
+
+    def on_token(self, request_id: str):
+        """Returns a ``cb(token_id)`` suitable for ``GenHandle.on_token``
+        / the SSE path, recording the stream for the monotonicity check."""
+        def cb(token: int) -> None:
+            with self._mu:
+                self._streams.setdefault(request_id, []).append(int(token))
+        return cb
+
+    def completed(self, request_id: str,
+                  tokens: Optional[List[int]] = None) -> None:
+        with self._mu:
+            self._terminal.setdefault(request_id, []).append("completed")
+            if tokens is not None:
+                self._results[request_id] = list(tokens)
+
+    def failed(self, request_id: str, reason: str = "") -> None:
+        with self._mu:
+            self._terminal.setdefault(request_id, []).append("failed")
+
+    def shed(self, request_id: str, status: int = 0) -> None:
+        """An admission-control rejection (429/503) IS a terminal
+        outcome: the client was explicitly told to retry elsewhere."""
+        with self._mu:
+            self._terminal.setdefault(request_id, []).append("shed")
+
+    def dead_lettered(self, request_id: str) -> None:
+        with self._mu:
+            self._terminal.setdefault(request_id, []).append(
+                "dead_lettered")
+
+    # -- the checks ----------------------------------------------------------
+
+    def violations(self) -> List[str]:
+        out: List[str] = []
+        with self._mu:
+            submitted = list(self._submitted)
+            terminal = {k: list(v) for k, v in self._terminal.items()}
+            streams = {k: list(v) for k, v in self._streams.items()}
+            results = {k: list(v) for k, v in self._results.items()}
+        seen = set()
+        for rid in submitted:
+            if rid in seen:
+                out.append(f"duplicate submission id {rid}")
+            seen.add(rid)
+            outcomes = terminal.get(rid, [])
+            if not outcomes:
+                out.append(f"LOST: {rid} reached no terminal outcome")
+            completions = sum(1 for o in outcomes if o == "completed")
+            if completions > 1:
+                out.append(f"DUPLICATE COMPLETION: {rid} completed "
+                           f"{completions}×")
+            # A request both completed and dead-lettered double-delivers
+            # the moment an operator requeues the DLQ copy.
+            if completions and "dead_lettered" in outcomes:
+                out.append(f"COMPLETED+DLQ: {rid} completed and was "
+                           f"dead-lettered")
+        for rid, stream in streams.items():
+            final = results.get(rid)
+            if final is None:
+                continue
+            if stream != final[:len(stream)]:
+                out.append(
+                    f"NON-MONOTONE STREAM: {rid} streamed {len(stream)} "
+                    f"tokens that are not a prefix of its {len(final)}-"
+                    f"token result")
+        return out
+
+    def check(self) -> None:
+        """Raise AssertionError listing every violated invariant."""
+        v = self.violations()
+        if v:
+            raise AssertionError(
+                "chaos invariants violated:\n  " + "\n  ".join(v))
+
+    def summary(self) -> Dict:
+        with self._mu:
+            outcomes: Dict[str, int] = {}
+            for os_ in self._terminal.values():
+                for o in os_:
+                    outcomes[o] = outcomes.get(o, 0) + 1
+            return {"submitted": len(self._submitted),
+                    "terminal": dict(outcomes),
+                    "streams": len(self._streams)}

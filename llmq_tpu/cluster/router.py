@@ -44,6 +44,8 @@ from llmq_tpu import observability
 from llmq_tpu.core.config import ClusterConfig
 from llmq_tpu.core.errors import NoEndpointError
 from llmq_tpu.core.types import Message
+from llmq_tpu.loadbalancer.circuit_breaker import (BreakerBoard,
+                                                   CircuitOpenError)
 from llmq_tpu.loadbalancer.load_balancer import (Endpoint, EndpointStatus,
                                                  LoadBalancer)
 from llmq_tpu.loadbalancer.router import EngineRouter
@@ -69,6 +71,14 @@ class ClusterRouter(EngineRouter):
             from llmq_tpu.metrics.registry import get_metrics
             self._metrics = get_metrics()
         self._mu = threading.Lock()
+        #: Per-endpoint circuit breakers (docs/robustness.md): blocked
+        #: endpoints are skipped at SELECTION (no probe-slot consumed);
+        #: the dispatch gate + outcome feedback live either in the
+        #: HTTP transport (which can tell endpoint faults from
+        #: deadline misses precisely) or — for local engines — right
+        #: around the dispatch below.
+        self.breakers = BreakerBoard(self.config.breaker,
+                                     enable_metrics=enable_metrics)
         #: Process-local fast map conv → endpoint id; the state
         #: manager's placement handle is the durable copy.
         self._affinity: Dict[str, str] = {}
@@ -88,6 +98,19 @@ class ClusterRouter(EngineRouter):
         if self._local_endpoint_id is None:
             self._local_endpoint_id = ep.id
         return ep
+
+    def engine_for(self, ep: Endpoint):
+        """EngineRouter.engine_for + breaker attachment: every HTTP
+        transport behind this router shares the router's per-endpoint
+        breaker, so the transport's precise outcome classification
+        (fault vs deadline miss) feeds the same state the selection
+        path consults."""
+        engine = super().engine_for(ep)
+        if (engine is not None and self.breakers.enabled
+                and hasattr(engine, "breaker")
+                and getattr(engine, "breaker", None) is None):
+            engine.breaker = self.breakers.breaker(ep.id)
+        return engine
 
     def register_peers(self, peers) -> None:
         """Bring up the configured replica set (idempotent per URL).
@@ -130,37 +153,52 @@ class ClusterRouter(EngineRouter):
                 pass
         return None
 
+    def _avoid(self, tried: set) -> set:
+        """Selection-time exclusion: endpoints already tried this
+        dispatch plus endpoints whose circuit breaker is blocking new
+        traffic (OPEN inside its backoff, or a half-open probe already
+        in flight). Uses the breaker's NON-consuming check — the
+        half-open probe slot is only taken at dispatch time."""
+        avoid = set(tried)
+        if self.breakers.enabled:
+            for ep in self.lb.endpoints():
+                if ep.id not in avoid and self.breakers.blocked(ep.id):
+                    avoid.add(ep.id)
+        return avoid
+
     def _acquire(self, msg: Message, session: Optional[str],
                  tried: set) -> "tuple[Endpoint, str]":
         """Pick + book one endpoint. Returns (endpoint, reason)."""
         aff = self.config.affinity
+        avoid = self._avoid(tried)
         if aff == "prefix" and session and not tried:
             eid = self._affine_endpoint(session)
             if eid is not None:
                 with self._mu:
                     self.affinity_eligible += 1
                 ep = self.lb.get_endpoint_by_id(eid)
-                if ep is not None and ep.load < self.config.spill_load:
+                if (ep is not None and ep.load < self.config.spill_load
+                        and eid not in avoid):
                     got = self.lb.acquire_endpoint(eid)
                     if got is not None:
                         with self._mu:
                             self.affinity_hits += 1
                         return got, "affinity"
-                # Saturated / draining / gone → spill via the LB
-                # strategy (EWMA load + response time under
+                # Saturated / draining / breaker-open / gone → spill
+                # via the LB strategy (EWMA load + response time under
                 # adaptive_load).
                 with self._mu:
                     self.spills += 1
                 return (self.lb.get_endpoint(msg, session_id=None,
-                                             exclude=tried), "spill")
+                                             exclude=avoid), "spill")
             return self.lb.get_endpoint(msg, session_id=None,
-                                        exclude=tried), "select"
+                                        exclude=avoid), "select"
         # "session" keeps the LB's own TTL session map; "none" and the
         # failover re-picks go strategy-only.
         sid = session if (aff == "session" and not tried) else None
         reason = "failover" if tried else "select"
         return self.lb.get_endpoint(msg, session_id=sid,
-                                    exclude=tried), reason
+                                    exclude=avoid), reason
 
     # -- dispatch ------------------------------------------------------------
 
@@ -195,6 +233,17 @@ class ClusterRouter(EngineRouter):
                     f"endpoint {ep.id} has no attached engine and no "
                     f"transport for url {ep.url!r}")
                 continue
+            # Dispatch gate for engines WITHOUT their own breaker (the
+            # HTTP transport carries one and gates/feeds it itself —
+            # double-counting here would halve the trip threshold).
+            own_breaker = getattr(engine, "breaker", None) is not None
+            if (not own_breaker and self.breakers.enabled
+                    and not self.breakers.allow(ep.id)):
+                self.lb.release_endpoint(ep.id)
+                tried.add(ep.id)
+                last_err = CircuitOpenError(
+                    ep.id, self.breakers.breaker(ep.id).retry_in())
+                continue
             observability.record(msg.id, "dispatched", endpoint=ep.id,
                                  reason=reason,
                                  priority=msg.priority.tier_name)
@@ -207,10 +256,26 @@ class ClusterRouter(EngineRouter):
                 # The remote side may have done (or still be doing) the
                 # work — re-dispatching would double-execute it. The
                 # worker's timeout/retry machinery owns this outcome.
+                # Deliberately NOT a breaker fault: a deadline miss says
+                # nothing about endpoint health — but a held half-open
+                # probe slot must be released.
+                if not own_breaker:
+                    self.breakers.record_timeout(ep.id)
                 self.lb.release_endpoint(ep.id, is_error=True)
                 raise
+            except CircuitOpenError as e:
+                # Raced the transport's own gate (breaker opened between
+                # selection and dispatch): nothing was sent — no
+                # endpoint-error penalty, no failover count, just move
+                # to another replica.
+                self.lb.release_endpoint(ep.id)
+                tried.add(ep.id)
+                last_err = e
+                continue
             except Exception as e:  # noqa: BLE001 — replica failure
                 self.lb.release_endpoint(ep.id, is_error=True)
+                if not own_breaker:
+                    self.breakers.record(ep.id, ok=False)
                 tried.add(ep.id)
                 last_err = e
                 with self._mu:
@@ -225,6 +290,8 @@ class ClusterRouter(EngineRouter):
                 continue
             finally:
                 reset_log_context(ltoken)
+            if not own_breaker:
+                self.breakers.record(ep.id, ok=True)
             self._commit(msg, ep, session, reason,
                          time.perf_counter() - t0)
             return
@@ -322,4 +389,5 @@ class ClusterRouter(EngineRouter):
             "failovers": failovers,
             "local_endpoint_id": self._local_endpoint_id,
             "endpoints": self.lb.get_stats(),
+            "breakers": self.breakers.get_stats(),
         }

@@ -191,6 +191,24 @@ VALID_CLUSTER_AFFINITY = ("prefix", "session", "none")
 
 
 @dataclass
+class BreakerConfig:
+    """Per-endpoint circuit breaker for remote dispatch
+    (loadbalancer/circuit_breaker.py, docs/robustness.md). Trips on
+    consecutive endpoint FAULTS (deadline misses never count), holds
+    the endpoint out of rotation for a jittered exponential backoff,
+    then admits one half-open probe dispatch."""
+    enabled: bool = True
+    #: Consecutive failures that trip CLOSED → OPEN.
+    failure_threshold: int = 3
+    #: First OPEN window in seconds; doubles per consecutive trip.
+    base_backoff: float = 1.0
+    max_backoff: float = 30.0
+    #: ± fraction of the backoff randomized (seeded per endpoint, so
+    #: scenarios replay deterministically).
+    jitter: float = 0.2
+
+
+@dataclass
 class ClusterConfig:
     """Replica-set serving plane (llmq_tpu/cluster/, docs/multihost.md).
 
@@ -224,6 +242,9 @@ class ClusterConfig:
     drain_timeout: float = 30.0
     #: HTTP transport budget per dispatch to a peer (seconds).
     peer_timeout: float = 120.0
+    #: Per-endpoint circuit breaker for the dispatch path
+    #: (docs/robustness.md).
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
 
     def __post_init__(self) -> None:
         if isinstance(self.peers, str):
@@ -284,6 +305,57 @@ class ObservabilityConfig:
     #: request in the ``POST /api/v1/generate`` response so the
     #: gateway can stitch a cross-process timeline.
     propagate_trace: bool = True
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault injection (llmq_tpu/chaos/,
+    docs/robustness.md). ``enabled: false`` (the DEFAULT) is a hard
+    off-switch: no injector exists and every compiled-in fault point is
+    a single attribute check — behavior identical to pre-chaos code."""
+    enabled: bool = False
+    #: Seeds every rule's RNG: same seed + same rules + same call
+    #: sequence ⇒ the same faults fire at the same places.
+    seed: int = 0
+    #: Fault rules, each ``{point, kind, probability, times,
+    #: latency_ms, match}`` (chaos/injector.py FaultRule). Points:
+    #: transport.request, transport.probe, engine.step,
+    #: engine.hbm_alloc, wal.append, wal.fsync (fnmatch patterns OK).
+    faults: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class OverloadConfig:
+    """Adaptive overload shedding at the API layer (api/overload.py,
+    docs/robustness.md): reject work the system cannot serve within
+    its SLA with an explicit 429/503 + Retry-After instead of letting
+    the backlog melt the engine. ``enabled: false`` is a hard
+    off-switch — no admission checks run at all."""
+    enabled: bool = True
+    #: Total queued messages (across this manager's queues) above which
+    #: new submissions get 429. 0 → 90% of queue.max_queue_size.
+    queue_depth_limit: int = 0
+    #: Shed when (estimated wait + prefill ETA) exceeds the request's
+    #: timeout × this factor — the request cannot meet its own SLA.
+    #: <= 0 disables the deadline-headroom check.
+    deadline_headroom: float = 1.0
+    #: Baseline Retry-After seconds when no better estimate exists.
+    retry_after: float = 1.0
+
+
+@dataclass
+class SupervisorConfig:
+    """Engine crash supervisor (engine/supervisor.py,
+    docs/robustness.md): detects a dead engine thread, fails the
+    in-flight handles (→ worker retry → WAL at-least-once redelivery,
+    already-finished handles deduped) and restarts the loop. A crash
+    LOOP is bounded: more than ``max_restarts`` within
+    ``restart_window`` seconds stops restarting — the engine stays
+    down, /health reports it, and the replica fails out of rotation."""
+    enabled: bool = True
+    check_interval: float = 0.5
+    max_restarts: int = 5
+    restart_window: float = 60.0
 
 
 @dataclass
@@ -397,6 +469,7 @@ class ExecutorConfig:
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     mixed_batch: MixedBatchConfig = field(default_factory=MixedBatchConfig)
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
 
 
 @dataclass
@@ -427,6 +500,8 @@ class Config:
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     executor: ExecutorConfig = field(default_factory=ExecutorConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)

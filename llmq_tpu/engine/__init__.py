@@ -12,10 +12,12 @@ from llmq_tpu.engine.engine import (
 )
 from llmq_tpu.engine.executor import EchoExecutor, ExecutorSpec, JaxExecutor
 from llmq_tpu.engine.kv_allocator import PageAllocator
+from llmq_tpu.engine.supervisor import EngineSupervisor
 from llmq_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer, get_tokenizer
 from llmq_tpu.engine.builder import build_engine
 
 __all__ = [
+    "EngineSupervisor",
     "ByteTokenizer",
     "EchoExecutor",
     "ExecutorSpec",

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from llmq_tpu import chaos
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.types import Message, Priority
 from llmq_tpu.engine.executor import Executor
@@ -542,7 +543,10 @@ class InferenceEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
+        # A DEAD thread object (crashed loop) must not block a restart
+        # — the supervisor's recovery path is start() after
+        # recover_after_crash(); only a LIVE thread makes this a no-op.
+        if self._thread is not None and self._thread.is_alive():
             return
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
@@ -572,16 +576,81 @@ class InferenceEngine:
         advances the LB state machine to UNHEALTHY → failover)."""
         return self.running
 
+    def recover_after_crash(self) -> Dict:
+        """Crash-recovery reset (engine/supervisor.py,
+        docs/robustness.md): called ONLY with the loop thread dead.
+        Every sequence the crashed loop owned — slot holders, pending,
+        inbox — has its pages/slots/locks released and its handle
+        finished with reason "error", which unblocks the worker thread
+        parked in ``process_fn`` → it raises → the worker retry path
+        requeues through the delayed queue + WAL (at-least-once, DLQ
+        backstop). Handles that already FINISHED before the crash are
+        left untouched — the completion-dedup half of the contract: a
+        completed request is never also pushed through the retry path,
+        so no final token is ever emitted twice.
+
+        Returns counts for the supervisor's log/metrics. The engine is
+        restart-ready afterwards (``start()`` brings up a fresh loop).
+        """
+        assert not self.running, "recover_after_crash needs a dead loop"
+        # The in-flight chunk's device output is unreachable (the dead
+        # loop owned its reconcile); drop the snapshot — its sequences
+        # are failed below and their retry re-prefills from scratch.
+        self._chunk_inflight = None
+        with self._mu:
+            inbox, self._inbox = self._inbox, []
+        pending = [s for (_, _, s) in self._pending]
+        self._pending = []
+        holders = [s for s in self._slots if s is not None]
+        recovered = 0
+        already_done = 0
+        for seq in holders + pending + inbox:
+            if seq.slot is not None:
+                try:
+                    self.executor.release_slot(seq.slot)
+                except Exception:  # noqa: BLE001 — executor state may
+                    pass           # be mid-crash; the reset must win
+                self._slots[seq.slot] = None
+                seq.slot = None
+            seq.first_handle = None
+            seq.mixed_pending = False
+            if seq.handle.done:
+                # Finished before the crash: dedup — do NOT re-fail or
+                # re-queue; the worker already owns the outcome.
+                already_done += 1
+                if seq.pages:
+                    self.allocator.free(seq.pages)
+                    seq.pages = []
+                continue
+            self._finish(seq, "error",
+                         "engine crashed; request requeued by supervisor")
+            recovered += 1
+        self._wake.clear()
+        log.warning(
+            "engine %s crash recovery: %d request(s) failed over to the "
+            "retry path, %d already finished (deduped)",
+            self.name, recovered, already_done)
+        return {"recovered": recovered, "already_done": already_done}
+
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                did_work = self.step()
-            except Exception:  # noqa: BLE001
-                log.exception("engine step failed")
-                did_work = False
-            if not did_work:
-                self._wake.wait(0.005)
-                self._wake.clear()
+        try:
+            while not self._stop.is_set():
+                try:
+                    did_work = self.step()
+                except Exception:  # noqa: BLE001
+                    log.exception("engine step failed")
+                    did_work = False
+                if not did_work:
+                    self._wake.wait(0.005)
+                    self._wake.clear()
+        except BaseException:
+            # A BaseException (injected chaos.EngineCrash, interpreter
+            # teardown, a bug in the except path) kills this thread.
+            # Log the death loudly — the supervisor
+            # (engine/supervisor.py) detects it and owns recovery.
+            log.exception("engine %s loop DIED — thread exiting; "
+                          "supervisor recovery takes over", self.name)
+            raise
 
     # -- core step -----------------------------------------------------------
 
@@ -600,6 +669,11 @@ class InferenceEngine:
         the reconcile-then-fresh-dispatch path, which rebuilds the
         batch from host state — so scheduling only ever acts on
         reconciled bookkeeping."""
+        # Chaos seam (docs/robustness.md): kind "error" is absorbed by
+        # the loop's except (one lost round); kind "crash" is a
+        # BaseException that sails past it and KILLS the engine thread
+        # — the supervisor's restart path is the handler under test.
+        chaos.fault("engine.step", engine=self.name)
         self._ingest()
         self._expire_pins()
         # Everything BEFORE the reconcile overlaps the in-flight chunk's
@@ -926,6 +1000,14 @@ class InferenceEngine:
         strictly less-urgent runner. A victim is only ever less urgent
         than ``requester`` — a low-tier request can never strip a
         realtime sequence's KV (priority inversion)."""
+        try:
+            # Chaos seam: a simulated HBM allocation failure behaves
+            # exactly like pool exhaustion — the requester stays
+            # pending and retries next round (never lost, never
+            # half-admitted).
+            chaos.fault("engine.hbm_alloc", engine=self.name)
+        except chaos.ChaosFault:
+            return None
         while True:
             pages = self.allocator.alloc(n)
             if pages is not None:

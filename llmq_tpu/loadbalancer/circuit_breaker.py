@@ -1,0 +1,317 @@
+"""Per-endpoint circuit breaker with jittered exponential backoff.
+
+The LB health probe is PERIODIC (``health_check_interval``, default
+30 s): between probes, a dead replica keeps receiving dispatches that
+each burn a connect timeout before failing over. The breaker closes
+that window from the DATA path: consecutive dispatch failures trip it,
+and while OPEN the router skips the endpoint instantly — no socket, no
+timeout — until a jittered exponential backoff elapses and a HALF_OPEN
+probe dispatch is allowed through. One success closes the breaker; a
+failed probe re-opens it with doubled backoff (capped).
+
+Deadline misses (TimeoutError) NEVER count as endpoint faults: a
+replica that is merely slow — or was handed an already-tight deadline —
+is not broken, and tripping on timeouts would amplify an overload into
+a self-inflicted outage (the classic retry-storm failure mode).
+
+The jitter is seeded per-breaker (endpoint id), so chaos scenarios
+replay deterministically while real fleets still de-synchronize their
+probe retries.
+
+States: CLOSED → (failure_threshold consecutive failures) → OPEN →
+(backoff elapses) → HALF_OPEN → success → CLOSED | failure → OPEN.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import threading
+import zlib
+from typing import Dict, Optional
+
+from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("circuit_breaker")
+
+
+class BreakerState(str, enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+#: Numeric encoding for the state gauge (alerting-friendly).
+STATE_VALUE = {BreakerState.CLOSED: 0, BreakerState.HALF_OPEN: 1,
+               BreakerState.OPEN: 2}
+
+
+class CircuitOpenError(RuntimeError):
+    """Dispatch refused because the endpoint's breaker is OPEN. Raised
+    instead of attempting the call — callers treat it as 'endpoint
+    unavailable right now' (failover/exclude), NOT as a fresh endpoint
+    fault (the breaker is already counting)."""
+
+    def __init__(self, endpoint: str, retry_in: float) -> None:
+        super().__init__(
+            f"circuit open for {endpoint}; next probe in {retry_in:.2f}s")
+        self.endpoint = endpoint
+        self.retry_in = retry_in
+
+
+class CircuitBreaker:
+    def __init__(self, endpoint_id: str, *,
+                 failure_threshold: int = 3,
+                 base_backoff: float = 1.0,
+                 max_backoff: float = 30.0,
+                 jitter: float = 0.2,
+                 clock: Optional[Clock] = None,
+                 seed: Optional[int] = None,
+                 metrics=None) -> None:
+        self.endpoint_id = endpoint_id
+        #: QueueMetrics (or None): state gauge + trip counter live HERE
+        #: — outcomes are recorded by whoever holds the breaker (the
+        #: HTTP transport for remote endpoints, the router for local
+        #: engines), so the metrics must ride the object, not any one
+        #: caller.
+        self._metrics = metrics
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.base_backoff = float(base_backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = max(0.0, min(1.0, float(jitter)))
+        self._clock = clock or SYSTEM_CLOCK
+        # Deterministic per-endpoint jitter stream: the endpoint id
+        # hashes into the seed so two breakers never share a sequence
+        # but a re-run of the same scenario replays exactly.
+        if seed is None:
+            seed = zlib.crc32(endpoint_id.encode("utf-8"))
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self.state = BreakerState.CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0
+        #: Consecutive trips without an intervening success — drives the
+        #: exponential backoff ladder.
+        self._trip_streak = 0
+        self._open_until = 0.0
+        #: True while a HALF_OPEN probe dispatch is in flight: exactly
+        #: one caller wins the probe slot per backoff window.
+        self._probe_inflight = False
+
+    # -- gate ----------------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May a dispatch proceed right now? OPEN → False until the
+        backoff elapses, then exactly ONE caller gets the HALF_OPEN
+        probe slot (the rest keep getting False until it resolves)."""
+        with self._mu:
+            if self.state == BreakerState.CLOSED:
+                return True
+            now = self._clock.now()
+            if self.state == BreakerState.OPEN and now >= self._open_until:
+                self.state = BreakerState.HALF_OPEN
+                self._probe_inflight = False
+                self._set_gauge()
+            if self.state == BreakerState.HALF_OPEN:
+                if self._probe_inflight:
+                    return False
+                self._probe_inflight = True
+                log.info("breaker %s half-open: probe dispatch allowed",
+                         self.endpoint_id)
+                return True
+            return False
+
+    def blocked(self) -> bool:
+        """Non-consuming eligibility check for endpoint SELECTION: True
+        while the endpoint must not receive new dispatch (OPEN inside
+        the backoff window, or HALF_OPEN with the probe slot already
+        taken). Unlike :meth:`allow`, never consumes the probe slot —
+        selection may scan many endpoints it ends up not dispatching
+        to."""
+        with self._mu:
+            if self.state == BreakerState.CLOSED:
+                return False
+            if self.state == BreakerState.HALF_OPEN:
+                return self._probe_inflight
+            return self._clock.now() < self._open_until
+
+    def retry_in(self) -> float:
+        """Seconds until the next probe slot (0 when not OPEN)."""
+        with self._mu:
+            if self.state != BreakerState.OPEN:
+                return 0.0
+            return max(0.0, self._open_until - self._clock.now())
+
+    # -- outcomes ------------------------------------------------------------
+
+    def record_success(self) -> None:
+        """One successful DISPATCH — the only evidence strong enough to
+        close the breaker and reset the backoff ladder."""
+        with self._mu:
+            self.consecutive_failures = 0
+            self._trip_streak = 0
+            self._probe_inflight = False
+            if self.state != BreakerState.CLOSED:
+                log.info("breaker %s closed (probe succeeded)",
+                         self.endpoint_id)
+                self.state = BreakerState.CLOSED
+                # Gauge only on a real transition: this runs once per
+                # successful dispatch — hot path.
+                self._set_gauge()
+
+    def record_probe_success(self) -> None:
+        """A passing HEALTH probe: weaker evidence than a dispatch — a
+        replica can serve /health 200 while failing every generate
+        (bad weights, full disk). It clears the failure streak of a
+        CLOSED breaker (sparse refusals must not read as consecutive)
+        but must NOT close an OPEN one or touch the half-open
+        arbitration — only a successful dispatch earns that."""
+        with self._mu:
+            if self.state == BreakerState.CLOSED:
+                self.consecutive_failures = 0
+
+    def record_timeout(self) -> None:
+        """A dispatch ended in a deadline miss: that says NOTHING about
+        endpoint health, so it must count neither as fault nor success
+        — but it MUST release a half-open probe slot the dispatch may
+        be holding. Without this, a probe that times out leaves
+        ``_probe_inflight`` latched and the endpoint is excluded from
+        rotation forever (the slot would never be re-granted)."""
+        with self._mu:
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        """One endpoint fault (NOT a deadline miss — callers must filter
+        TimeoutError before reaching here)."""
+        with self._mu:
+            self.consecutive_failures += 1
+            if self.state == BreakerState.HALF_OPEN:
+                self._trip(probe_failed=True)
+            elif (self.state == BreakerState.CLOSED
+                  and self.consecutive_failures >= self.failure_threshold):
+                self._trip()
+
+    def _trip(self, probe_failed: bool = False) -> None:
+        self._trip_streak += 1
+        self.trips += 1
+        backoff = min(self.max_backoff,
+                      self.base_backoff * (2.0 ** (self._trip_streak - 1)))
+        if self.jitter:
+            # ± jitter fraction, seeded (see __init__).
+            backoff *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        self.state = BreakerState.OPEN
+        self._probe_inflight = False
+        self._open_until = self._clock.now() + backoff
+        if self._metrics is not None:
+            try:
+                self._metrics.circuit_breaker_trips.labels(
+                    self.endpoint_id).inc()
+            except Exception:  # noqa: BLE001 — never couple the data
+                pass           # path to the metrics plane
+        self._set_gauge()
+        log.warning("breaker %s OPEN for %.2fs (%s, trip #%d)",
+                    self.endpoint_id, backoff,
+                    "half-open probe failed" if probe_failed
+                    else f"{self.consecutive_failures} consecutive failures",
+                    self.trips)
+
+    def _set_gauge(self) -> None:
+        """Caller holds self._mu."""
+        if self._metrics is not None:
+            try:
+                self._metrics.circuit_breaker_state.labels(
+                    self.endpoint_id).set(STATE_VALUE[self.state])
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            return {
+                "endpoint": self.endpoint_id,
+                "state": self.state.value,
+                "consecutive_failures": self.consecutive_failures,
+                "trips": self.trips,
+                "retry_in": (max(0.0, self._open_until - self._clock.now())
+                             if self.state == BreakerState.OPEN else 0.0),
+            }
+
+
+class BreakerBoard:
+    """Per-endpoint breaker registry for a router (one breaker per
+    endpoint id, created on first use from one config)."""
+
+    def __init__(self, config=None, *, clock: Optional[Clock] = None,
+                 enable_metrics: bool = True) -> None:
+        #: cluster.breaker config (core.config.BreakerConfig) or any
+        #: object with the same fields; None → defaults.
+        self.config = config
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._metrics = None
+        if enable_metrics:
+            try:
+                from llmq_tpu.metrics.registry import get_metrics
+                self._metrics = get_metrics()
+            except Exception:  # noqa: BLE001
+                self._metrics = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.config is None or getattr(self.config, "enabled", True)
+
+    def breaker(self, endpoint_id: str) -> CircuitBreaker:
+        with self._mu:
+            br = self._breakers.get(endpoint_id)
+            if br is None:
+                cfg = self.config
+                br = CircuitBreaker(
+                    endpoint_id,
+                    failure_threshold=getattr(cfg, "failure_threshold", 3),
+                    base_backoff=getattr(cfg, "base_backoff", 1.0),
+                    max_backoff=getattr(cfg, "max_backoff", 30.0),
+                    jitter=getattr(cfg, "jitter", 0.2),
+                    clock=self._clock,
+                    metrics=self._metrics)
+                self._breakers[endpoint_id] = br
+            return br
+
+    def allow(self, endpoint_id: str) -> bool:
+        if not self.enabled:
+            return True
+        return self.breaker(endpoint_id).allow()
+
+    def blocked(self, endpoint_id: str) -> bool:
+        """Selection-time check (never consumes the half-open probe
+        slot). Unknown endpoints are not blocked."""
+        if not self.enabled:
+            return False
+        with self._mu:
+            br = self._breakers.get(endpoint_id)
+        return br.blocked() if br is not None else False
+
+    def record(self, endpoint_id: str, ok: bool) -> None:
+        """Outcome feedback for engines without their own breaker (the
+        HTTP transport records directly on the shared breaker object;
+        metrics ride the breaker either way)."""
+        if not self.enabled:
+            return
+        br = self.breaker(endpoint_id)
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure()
+
+    def record_timeout(self, endpoint_id: str) -> None:
+        if not self.enabled:
+            return
+        with self._mu:
+            br = self._breakers.get(endpoint_id)
+        if br is not None:
+            br.record_timeout()
+
+    def get_stats(self) -> Dict:
+        with self._mu:
+            return {eid: br.get_stats()
+                    for eid, br in self._breakers.items()}

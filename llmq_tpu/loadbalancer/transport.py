@@ -26,39 +26,111 @@ LoadBalancer strategies, session affinity and failover.
 
 from __future__ import annotations
 
+import errno
 import json
 import urllib.error
 import urllib.request
 from typing import Optional
 
+from llmq_tpu import chaos
 from llmq_tpu.core.types import Message
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("transport")
 
+#: Probe outcomes whose cause is a REFUSED connection (nothing listens
+#: at the address — the replica process is gone). These fast-fail in
+#: ~1 RTT and feed the circuit breaker; slow probes (timeout) and
+#: application-level failures (5xx, draining, stopped engine) do not —
+#: a slow or draining peer is not a broken one, and tripping the
+#: breaker on it would amplify load problems into outages.
+PROBE_FAST_FAIL = ("refused",)
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    """Socket-timeout detection through urllib's URLError wrapping."""
+    seen = exc
+    for _ in range(4):
+        if isinstance(seen, TimeoutError):
+            return True
+        seen = getattr(seen, "reason", None) or getattr(
+            seen, "__cause__", None)
+        if seen is None:
+            return False
+    return False
+
+
+def _is_refused(exc: BaseException) -> bool:
+    """Connection-refused detection through urllib's wrapping: URLError
+    carries the socket error as ``reason``."""
+    seen = exc
+    for _ in range(4):              # URLError(OSError(...)) chains
+        if isinstance(seen, ConnectionRefusedError):
+            return True
+        if isinstance(seen, OSError) and seen.errno in (
+                errno.ECONNREFUSED, errno.EHOSTUNREACH):
+            return True
+        seen = getattr(seen, "reason", None) or getattr(
+            seen, "__cause__", None)
+        if seen is None:
+            return False
+    return False
+
 
 class HttpEngineClient:
-    """Remote engine behind a serve process's REST API."""
+    """Remote engine behind a serve process's REST API.
+
+    ``breaker`` (loadbalancer/circuit_breaker.py) gates the dispatch
+    path when attached: an OPEN breaker refuses instantly with
+    :class:`CircuitOpenError` instead of burning a connect timeout, and
+    dispatch outcomes feed it — endpoint faults count, deadline misses
+    (TimeoutError) never do."""
 
     def __init__(self, base_url: str, *, timeout: float = 120.0,
-                 probe_timeout: float = 2.0) -> None:
+                 probe_timeout: float = 2.0, breaker=None) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.probe_timeout = probe_timeout
         self.name = self.base_url
+        self.breaker = breaker
 
     # -- engine-compatible seams --------------------------------------------
 
-    def healthy(self) -> bool:
+    def probe(self) -> str:
+        """One health probe with a CAUSE-granular verdict: "ok", or why
+        not — "refused" (fast-fail: nothing listens there; feeds the
+        breaker), "timeout" (slow probe), "http_error", "bad_response",
+        "draining", "stopped". ``healthy()`` keeps the boolean contract
+        the LB probe machinery uses."""
+        try:
+            chaos.fault("transport.probe", endpoint=self.name)
+        except chaos.ChaosTimeout:
+            return "timeout"
+        except chaos.ChaosFault:
+            verdict = "refused"
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            return verdict
         try:
             with urllib.request.urlopen(
                     f"{self.base_url}/health",
                     timeout=self.probe_timeout) as resp:
                 if resp.status != 200:
-                    return False
+                    return "http_error"
                 data = json.loads(resp.read().decode("utf-8"))
-        except (urllib.error.URLError, OSError, ValueError):
-            return False
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if _is_refused(e):
+                # Nothing listening: the strongest possible down-signal,
+                # known in ~1 RTT. Feed the breaker so the DATA path
+                # stops paying connect timeouts before the next
+                # dispatch even happens.
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                return "refused"
+            if isinstance(e, TimeoutError) or _is_timeout(e):
+                return "timeout"
+            return "bad_response" if isinstance(e, ValueError) \
+                else "http_error"
         # A serve peer reports its engine thread; "stopped" means the
         # process is up but cannot generate — unhealthy for routing. A
         # peer that announces status "draining" (SIGTERM / admin drain,
@@ -66,13 +138,32 @@ class HttpEngineClient:
         # also unhealthy for routing, so remote LBs stop dispatching
         # without any cluster-wide control channel.
         if data.get("status") == "draining":
-            return False
-        return data.get("engine", "running") == "running"
+            return "draining"
+        if data.get("engine", "running") != "running":
+            return "stopped"
+        # A clean probe is positive evidence: without this, an idle
+        # endpoint's sparse refusals (one per replica restart, days
+        # apart) would read as "consecutive" and trip the breaker.
+        # Probe-grade only — it clears a CLOSED breaker's streak but
+        # never closes an OPEN one (a replica can be /health-200 yet
+        # fail every dispatch; only a real dispatch success re-admits).
+        if self.breaker is not None:
+            self.breaker.record_probe_success()
+        return "ok"
+
+    def healthy(self) -> bool:
+        return self.probe() == "ok"
 
     def process_fn(self, ctx, msg: Message) -> None:
         """Worker seam: relay one drained message to the peer and fold
         the completion back into ``msg`` (same contract as
-        ``InferenceEngine.process_fn``)."""
+        ``InferenceEngine.process_fn``).
+
+        Ordering of the gates matters: the DEADLINE check runs first —
+        an already-expired context must raise TimeoutError without
+        dispatching (and without touching the breaker: an expired
+        deadline says nothing about the endpoint) — then the breaker,
+        then the chaos fault point, then the real dispatch."""
         timeout: Optional[float] = self.timeout
         if ctx is not None:
             rem = ctx.remaining()
@@ -81,6 +172,24 @@ class HttpEngineClient:
                     raise TimeoutError(
                         f"message {msg.id} deadline expired before dispatch")
                 timeout = min(self.timeout, rem)
+        if self.breaker is not None and not self.breaker.allow():
+            from llmq_tpu.loadbalancer.circuit_breaker import \
+                CircuitOpenError
+            raise CircuitOpenError(self.name, self.breaker.retry_in())
+        try:
+            chaos.fault("transport.request", endpoint=self.name)
+        except chaos.ChaosTimeout:
+            # Indeterminate outcome by design (timeout / lost
+            # response): never an endpoint fault — but a held half-open
+            # probe slot must be released or the endpoint never
+            # re-enters rotation.
+            if self.breaker is not None:
+                self.breaker.record_timeout()
+            raise
+        except chaos.ChaosFault:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
         payload = msg.to_dict()
         payload["timeout"] = timeout
         # W3C trace context rides the hop (docs/observability.md): the
@@ -111,9 +220,16 @@ class HttpEngineClient:
             except Exception:  # noqa: BLE001
                 pass
             if e.code == 504:
+                # Deadline miss on the replica: not an endpoint fault —
+                # no failure is recorded, but a held half-open probe
+                # slot is released (record_timeout).
+                if self.breaker is not None:
+                    self.breaker.record_timeout()
                 raise TimeoutError(
                     f"remote engine {self.base_url} timed out: {detail}"
                 ) from None
+            if self.breaker is not None:
+                self.breaker.record_failure()
             raise RuntimeError(
                 f"remote engine {self.base_url} failed "
                 f"({e.code}): {detail}") from None
@@ -128,11 +244,17 @@ class HttpEngineClient:
             # the endpoint instead of re-burning the full budget on it.
             if isinstance(e, TimeoutError) and not isinstance(
                     e, urllib.error.URLError):
+                if self.breaker is not None:
+                    self.breaker.record_timeout()
                 raise TimeoutError(
                     f"remote engine {self.base_url} exceeded its "
                     f"{timeout:.0f}s budget (+headroom)") from None
+            if self.breaker is not None:
+                self.breaker.record_failure()
             raise RuntimeError(
                 f"remote engine {self.base_url} unreachable: {e}") from None
+        if self.breaker is not None:
+            self.breaker.record_success()
         msg.response = data.get("response", "")
         usage = data.get("usage")
         if usage:

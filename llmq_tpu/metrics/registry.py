@@ -209,6 +209,37 @@ class QueueMetrics:
             f"{ns}_dead_letter_depth",
             "Messages currently parked in a dead-letter queue",
             ["queue"], registry=registry)
+        self.dlq_handler_errors = Counter(
+            f"{ns}_dlq_handler_errors_total",
+            "DLQ handler/subscriber callbacks that raised (the push "
+            "itself and the remaining handlers still ran)",
+            ["queue"], registry=registry)
+        # Robustness plane (llmq_tpu/chaos/, docs/robustness.md):
+        self.chaos_injected = Counter(
+            f"{ns}_chaos_injected_total",
+            "Faults injected by the chaos plane", ["point", "kind"],
+            registry=registry)
+        self.requests_shed = Counter(
+            f"{ns}_requests_shed_total",
+            "Requests rejected by overload shedding; reason is "
+            "backlog|sla|engine_down, code the HTTP status returned",
+            ["reason", "code"], registry=registry)
+        self.circuit_breaker_state = Gauge(
+            f"{ns}_circuit_breaker_state",
+            "Per-endpoint breaker state (0=closed, 1=half_open, 2=open)",
+            ["endpoint"], registry=registry)
+        self.circuit_breaker_trips = Counter(
+            f"{ns}_circuit_breaker_trips_total",
+            "Breaker transitions into OPEN per endpoint", ["endpoint"],
+            registry=registry)
+        self.engine_restarts = Counter(
+            f"{ns}_engine_restarts_total",
+            "Engine loop restarts performed by the supervisor",
+            ["engine"], registry=registry)
+        self.engine_recovered_requests = Counter(
+            f"{ns}_engine_recovered_requests_total",
+            "In-flight requests failed over to the retry path by an "
+            "engine crash recovery", ["engine"], registry=registry)
 
 
 def get_metrics() -> QueueMetrics:

@@ -97,12 +97,26 @@ class DeadLetterQueue:
             self._items[message.id] = item
             handlers = list(self._handlers)
             self._set_depth_gauge()
+        # Handlers run OUTSIDE the lock, each individually wrapped: a
+        # raising handler/subscriber must neither abort the push (the
+        # item is already stored above) nor starve the remaining
+        # handlers — and the failure is counted, not just logged
+        # (dlq_handler_errors_total; a silently-broken alerting hook is
+        # itself an outage multiplier).
         for h in handlers:
             try:
                 h(item)
             except Exception:  # noqa: BLE001
                 log.exception("DLQ handler failed for message %s", message.id)
+                self._count_handler_error()
         return item
+
+    def _count_handler_error(self) -> None:
+        try:
+            from llmq_tpu.metrics.registry import get_metrics
+            get_metrics().dlq_handler_errors.labels(self.name).inc()
+        except Exception:  # noqa: BLE001 — best-effort, like the depth
+            pass           # gauge: never couple the DLQ to metrics
 
     def get(self, message_id: str) -> DeadLetterItem:
         with self._lock:

@@ -31,10 +31,31 @@ import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from llmq_tpu import chaos
 from llmq_tpu.core.types import Message
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("wal")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the DIRECTORY containing ``path``: POSIX does not promise
+    a rename survives a crash until the directory entry itself is
+    synced — without this, a crash immediately after compaction's
+    ``os.replace`` can lose the compacted journal entirely (both the
+    old file's unlink and the new name sit in the unsynced dir).
+    Best-effort on platforms where directories can't be opened."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 _TERMINAL = ("complete", "fail", "remove")
 _LIVE_PENDING = "pending"
@@ -77,6 +98,10 @@ class QueueWAL:
 
     def append(self, op: str, queue: str, message_id: str,
                message: Optional[Message] = None) -> None:
+        # Chaos seam (docs/robustness.md): an injected failure here
+        # surfaces to the caller BEFORE the queue mutation commits —
+        # the client is told, nothing is silently half-recorded.
+        chaos.fault("wal.append", op=op, queue=queue)
         if op == "push" and message is not None:
             line = _push_line(queue, message)
         else:
@@ -96,6 +121,11 @@ class QueueWAL:
             elif op in _TERMINAL:
                 self._live = max(0, self._live - 1)
             if self._since_sync >= self.fsync_every:
+                # Chaos seam: a failing fsync propagates (the caller's
+                # push fails loudly); the record itself is already
+                # written+flushed, so replay still sees it — reduced
+                # durability window, never corruption.
+                chaos.fault("wal.fsync")
                 os.fsync(self._f.fileno())
                 self._since_sync = 0
 
@@ -176,6 +206,10 @@ class QueueWAL:
             f.close()
             self._f.close()
             os.replace(f.name, self.path)
+            # The rename is only durable once the DIRECTORY entry is
+            # synced — a crash right here must not lose the compacted
+            # journal (satellite fix; see _fsync_dir).
+            _fsync_dir(self.path)
             self._f = open(self.path, "a", encoding="utf-8")
             self._records = n_live + len(buf)
             self._live = min(self._live, self._records)
@@ -201,6 +235,7 @@ class QueueWAL:
                 os.fsync(f.fileno())
             self._f.close()
             os.replace(tmp, self.path)
+            _fsync_dir(self.path)
             self._f = open(self.path, "a", encoding="utf-8")
             self._records = len(live)
             self._live = len(live)

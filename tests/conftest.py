@@ -28,6 +28,23 @@ except AttributeError:
     # site hook imports jax but never touches devices).
     pass
 
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+# Hung-test diagnosability (ISSUE 5 satellite): the tier-1 gate runs
+# under `timeout -k 10 870`, which delivers SIGTERM on expiry — dump
+# every thread's stack THEN die, so a wedged chaos/cluster test names
+# the exact blocking frame instead of reading as a silent kill. SIGUSR1
+# is registered non-fatally for live debugging of a stuck local run.
+faulthandler.enable()
+try:
+    faulthandler.register(signal.SIGTERM, chain=True)
+    faulthandler.register(signal.SIGUSR1, chain=False)
+except (AttributeError, ValueError, OSError):
+    # Platforms without register()/these signals (e.g. Windows): the
+    # plain enable() above still covers hard crashes.
+    pass
+
 import pytest  # noqa: E402
 
 from llmq_tpu.core.clock import FakeClock  # noqa: E402

@@ -1,0 +1,548 @@
+"""Chaos harness (docs/robustness.md): seeded fault scenarios against
+the invariant checker.
+
+Every scenario here is DETERMINISTIC — faults fire from seeded rules
+(llmq_tpu/chaos/), never from wall-clock races — and ends with
+``InvariantChecker.check()``: zero message loss, zero duplicate
+completions, monotone per-request token streams. The final class pins
+the hard off-switches: with ``chaos.enabled=false`` and
+``overload.enabled=false`` the serving paths are byte-identical to the
+pre-chaos code (no injector exists, no shedder exists).
+
+Reproduction recipe for a failure: every scenario prints its seed in
+the assertion context; re-run with the same seed and rule list to
+replay the exact fault sequence (docs/robustness.md §chaos-seeds).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llmq_tpu import chaos
+from llmq_tpu.api.server import ApiServer
+from llmq_tpu.chaos import InvariantChecker
+from llmq_tpu.core.config import (ChaosConfig, SupervisorConfig,
+                                  default_config)
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.engine import (ByteTokenizer, EchoExecutor, EngineSupervisor,
+                             InferenceEngine)
+from llmq_tpu.engine.engine import GenRequest
+from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
+from llmq_tpu.queueing.queue_manager import QueueManager
+from llmq_tpu.queueing.wal import QueueWAL
+from llmq_tpu.queueing.worker import Worker
+
+pytestmark = [
+    pytest.mark.chaos,
+    # Injected EngineCrash kills engine threads ON PURPOSE; pytest's
+    # thread-exception watchdog would otherwise warn on every scenario.
+    pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"),
+]
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    """Every scenario leaves the process with chaos DISARMED."""
+    yield
+    chaos.configure(None)
+
+
+def _arm(seed: int, *rules) -> chaos.FaultInjector:
+    inj = chaos.configure(ChaosConfig(enabled=True, seed=seed))
+    for r in rules:
+        inj.add_rule(**r)
+    return inj
+
+
+def _engine(name: str = "chaos0", **kw) -> InferenceEngine:
+    kw.setdefault("enable_metrics", False)
+    kw.setdefault("max_decode_steps", 24)
+    return InferenceEngine(EchoExecutor(batch_size=4), ByteTokenizer(),
+                           name=name, **kw)
+
+
+def _stack(engine, checker, name: str, *, backoff: float = 0.05):
+    """QueueManager + Worker + DLQ wired into the invariant checker:
+    completions counted at the QUEUE-PLANE seam (where a duplicate
+    would double-deliver), DLQ arrivals recorded as terminal."""
+    cfg = default_config()
+    cfg.queue.enable_metrics = False
+    cfg.queue.worker.process_interval = 0.005
+    cfg.queue.retry.initial_backoff = backoff
+    cfg.queue.retry.max_backoff = backoff * 4
+    mgr = QueueManager(name, config=cfg, enable_metrics=False)
+    dlq = DeadLetterQueue(name=f"{name}-dlq")
+    dlq.add_handler(lambda item: checker.dead_lettered(item.message.id))
+    orig_complete = mgr.complete_message
+
+    def complete(m, t=0.0, q=None):
+        checker.completed(m.id)
+        orig_complete(m, t, q)
+
+    mgr.complete_message = complete
+    worker = Worker("w0", mgr, engine.process_fn,
+                    dead_letter_queue=dlq)
+    return mgr, worker, dlq
+
+
+def _await_terminal(checker, n, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = checker.summary()
+        if sum(s["terminal"].values()) >= n:
+            return s
+        time.sleep(0.02)
+    raise AssertionError(
+        f"only {checker.summary()} terminal after {timeout}s")
+
+
+class TestInjectorDeterminism:
+    def test_same_seed_same_firing_pattern(self):
+        def pattern(seed):
+            inj = chaos.FaultInjector(seed=seed)
+            inj.add_rule("p", kind="error", probability=0.5)
+            fired = []
+            for _ in range(64):
+                try:
+                    inj.fault("p")
+                    fired.append(0)
+                except chaos.ChaosFault:
+                    fired.append(1)
+            return fired
+
+        a, b = pattern(1234), pattern(1234)
+        assert a == b                      # replayable
+        assert 10 < sum(a) < 54            # actually probabilistic
+        assert pattern(99) != a            # seed matters
+
+    def test_times_after_and_match_filters(self):
+        inj = chaos.FaultInjector(seed=0)
+        inj.add_rule("t", kind="error", times=2, after=1,
+                     endpoint="only-this")
+        outcomes = []
+        for i in range(5):
+            try:
+                inj.fault("t", endpoint="only-this")
+                outcomes.append("ok")
+            except chaos.ChaosFault:
+                outcomes.append("fault")
+        # First eligible call passes (after=1), next two fault
+        # (times=2), then exhausted.
+        assert outcomes == ["ok", "fault", "fault", "ok", "ok"]
+        inj.fault("t", endpoint="someone-else")   # filtered: no raise
+        assert inj.get_stats()["injected"] == {"t:error": 2}
+
+    def test_disabled_is_a_noop(self):
+        chaos.configure(ChaosConfig(enabled=False, faults=[
+            {"point": "engine.step", "kind": "crash"}]))
+        assert chaos.get_injector() is None
+        chaos.fault("engine.step")          # must not raise
+
+
+class TestEngineCrashRecovery:
+    def test_crash_under_load_zero_loss(self):
+        """Engine thread killed mid-serving: the supervisor restarts
+        it, in-flight requests fail over to the worker retry path (WAL
+        semantics: at-least-once), and EVERY request completes exactly
+        once."""
+        _arm(11, {"point": "engine.step", "kind": "crash", "times": 1,
+                  "after": 6})
+        checker = InvariantChecker()
+        engine = _engine("crashload")
+        engine.start()
+        sup = EngineSupervisor(
+            engine, config=SupervisorConfig(check_interval=0.02,
+                                            max_restarts=10),
+            enable_metrics=False)
+        sup.start()
+        mgr, worker, dlq = _stack(engine, checker, "crashload")
+        worker.start()
+        try:
+            for i in range(10):
+                m = Message(id=f"c{i}", content=f"chaos payload {i}",
+                            user_id="u", timeout=20.0)
+                checker.submitted(m.id)
+                mgr.push_message(m)
+            s = _await_terminal(checker, 10)
+        finally:
+            worker.stop()
+            sup.stop()
+            engine.stop()
+            mgr.stop()
+        checker.check()
+        assert s["terminal"].get("completed", 0) == 10, s
+        assert dlq.size() == 0
+        assert sup.restarts >= 1
+        assert sup.recovered_total >= 1
+
+    def test_crash_mid_stream_monotone_tokens(self):
+        """A crash with tokens already streamed must end the stream as
+        an explicit error whose partial tokens are a PREFIX of the
+        recorded result — never replayed, never extended after death.
+        The client retry then completes cleanly."""
+        inj = _arm(12)
+        checker = InvariantChecker()
+        engine = _engine("crashstream")
+        sup = EngineSupervisor(engine, config=SupervisorConfig(),
+                               enable_metrics=False)
+        h = engine.submit(GenRequest(id="s0",
+                                     prompt="stream me through a crash",
+                                     max_new_tokens=24),
+                          on_token=checker.on_token("s0"))
+        checker.submitted("s0")
+        # Drive synchronously until tokens are flowing…
+        for _ in range(200):
+            engine.step()
+            if len(checker._streams.get("s0", [])) >= 3:
+                break
+        assert len(checker._streams.get("s0", [])) >= 3
+        # …then arm the crash and hand the engine to its loop thread:
+        # the FIRST threaded step kills it. Fully deterministic.
+        inj.add_rule("engine.step", kind="crash", times=1)
+        engine.start()
+        deadline = time.time() + 5.0
+        while engine.running and time.time() < deadline:
+            time.sleep(0.01)
+        assert not engine.running           # thread is dead
+        assert sup.check_once()             # detect + recover + restart
+        assert h.wait(2.0)
+        assert h.result.finish_reason == "error"
+        checker.failed("s0")
+        checker.completed("s0", tokens=h.result.tokens)
+        # The "completed" record above carries the result tokens for
+        # the monotonicity check only — it is the SAME terminal event
+        # as the failure, not a second one.
+        checker._terminal["s0"].remove("completed")
+        assert engine.running               # restarted
+        # Client retry (new id — the old stream was answered with an
+        # explicit error): completes on the restarted engine.
+        h2 = engine.submit(GenRequest(id="s1",
+                                      prompt="stream me through a crash",
+                                      max_new_tokens=24),
+                           on_token=checker.on_token("s1"))
+        checker.submitted("s1")
+        assert h2.wait(10.0)
+        assert h2.result.finish_reason in ("eos", "length")
+        checker.completed("s1", tokens=h2.result.tokens)
+        engine.stop()
+        checker.check()
+
+    def test_hbm_alloc_faults_delay_but_never_lose(self):
+        """Simulated HBM allocation failures behave as transient pool
+        exhaustion: admission retries and every request completes."""
+        _arm(13, {"point": "engine.hbm_alloc", "kind": "error",
+                  "times": 5})
+        engine = _engine("hbm")
+        handles = [engine.submit(GenRequest(id=f"a{i}",
+                                            prompt=f"alloc fault {i}",
+                                            max_new_tokens=8))
+                   for i in range(4)]
+        engine.run_until_idle()
+        for h in handles:
+            assert h.result is not None
+            assert h.result.finish_reason in ("eos", "length")
+
+    def test_supervisor_gives_up_on_crash_loop(self):
+        """A crash LOOP must not restart forever: after max_restarts
+        within the window the engine stays down and reads unhealthy
+        (the replica fails out of rotation instead of flapping)."""
+        _arm(14, {"point": "engine.step", "kind": "crash"})   # every step
+        engine = _engine("crashloop")
+        sup = EngineSupervisor(
+            engine, config=SupervisorConfig(max_restarts=2,
+                                            restart_window=60.0),
+            enable_metrics=False)
+        engine.start()
+        restarts = 0
+        deadline = time.time() + 10.0
+        while not sup.gave_up and time.time() < deadline:
+            if not engine.running:
+                if sup.check_once():
+                    restarts += 1
+            time.sleep(0.005)
+        assert sup.gave_up
+        assert restarts == 2
+        assert not engine.running
+        assert not engine.healthy()
+
+
+class TestFlappingTransport:
+    def test_flapping_replicas_zero_loss(self):
+        """Randomly failing HTTP dispatch (p=0.4, seeded) across two
+        replicas: failover + worker retries + DLQ backstop must leave
+        every message completed or parked — none lost, none doubled."""
+        from llmq_tpu.cluster.router import ClusterRouter
+        from llmq_tpu.core.config import BreakerConfig, ClusterConfig
+        from llmq_tpu.core.config import LoadBalancerConfig
+        from llmq_tpu.loadbalancer import LoadBalancer
+
+        _arm(21, {"point": "transport.request", "kind": "error",
+                  "probability": 0.4})
+        checker = InvariantChecker()
+        engines, servers, urls = [], [], []
+        for i in range(2):
+            eng = _engine(f"flap{i}")
+            eng.start()
+            api = ApiServer(default_config(), engine=eng)
+            port = api.start(host="127.0.0.1", port=0)
+            engines.append(eng)
+            servers.append(api)
+            urls.append(f"http://127.0.0.1:{port}")
+        lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                             health_check_interval=0.0))
+        router = ClusterRouter(
+            lb, config=ClusterConfig(
+                failover_retries=3,
+                breaker=BreakerConfig(failure_threshold=3,
+                                      base_backoff=0.05, jitter=0.2)),
+            enable_metrics=False)
+        for url in urls:
+            router.register_remote(url,
+                                   endpoint_id=url.split("//")[1])
+        mgr, worker, dlq = _stack(router, checker, "flap")
+        worker.start()
+        try:
+            for i in range(16):
+                m = Message(id=f"f{i}", content=f"flap {i}", user_id="u",
+                            timeout=15.0)
+                checker.submitted(m.id)
+                mgr.push_message(m)
+            s = _await_terminal(checker, 16, timeout=40.0)
+        finally:
+            worker.stop()
+            mgr.stop()
+            for api in servers:
+                api.stop()
+            for eng in engines:
+                eng.stop()
+        checker.check()
+        total = (s["terminal"].get("completed", 0)
+                 + s["terminal"].get("dead_lettered", 0))
+        assert total == 16, s
+        # The chaos plane really fired.
+        inj = chaos.get_injector()
+        assert inj.get_stats()["injected"].get(
+            "transport.request:error", 0) > 0
+
+
+class TestWalFaults:
+    def test_append_fault_fails_push_loudly_and_cleanly(self, tmp_path):
+        """An injected WAL append failure must surface to the client
+        (push raises) and leave NOTHING half-recorded: the journal
+        replays to exactly the successfully-pushed set."""
+        _arm(31, {"point": "wal.append", "kind": "oserror", "times": 1,
+                  "match": {"op": "push"}})
+        wal_path = str(tmp_path / "chaos.wal")
+        mgr = QueueManager("walchaos", enable_metrics=False,
+                          wal_path=wal_path)
+        with pytest.raises(OSError):
+            mgr.push_message(Message(id="w0", content="x", user_id="u"))
+        for i in range(1, 4):
+            mgr.push_message(Message(id=f"w{i}", content="x",
+                                     user_id="u"))
+        assert mgr.total_pending() == 3
+        mgr.stop()
+        chaos.configure(None)
+        restored = {m.id for _, m in QueueWAL.replay(wal_path)}
+        assert restored == {"w1", "w2", "w3"}   # w0: client was told
+
+    def test_fsync_fault_never_loses_acknowledged_records(self,
+                                                          tmp_path):
+        """fsync failures reduce the durability window but must never
+        corrupt: every record written before OR after the fault window
+        replays."""
+        _arm(32, {"point": "wal.fsync", "kind": "oserror", "times": 2})
+        path = str(tmp_path / "fsync.wal")
+        wal = QueueWAL(path, fsync_every=1)
+        outcomes = []
+        for i in range(6):
+            m = Message(id=f"s{i}", content="x", user_id="u")
+            try:
+                wal.append("push", "normal", m.id, m)
+                outcomes.append("ok")
+            except OSError:
+                outcomes.append("fsync-fault")
+        wal.close()
+        assert outcomes.count("fsync-fault") == 2
+        restored = {m.id for _, m in QueueWAL.replay(path)}
+        # The record is flushed BEFORE the fsync point: even the two
+        # faulted appends are on disk — reduced durability window,
+        # zero corruption, zero loss.
+        assert restored == {f"s{i}" for i in range(6)}
+
+
+class TestOverloadBurst:
+    def _burst_stack(self, depth_limit=8):
+        from llmq_tpu.queueing.factory import QueueFactory, QueueType
+
+        cfg = default_config()
+        cfg.queue.enable_metrics = False
+        cfg.queue.worker.process_interval = 0.005
+        cfg.loadbalancer.health_check_interval = 0.0
+        cfg.overload.queue_depth_limit = depth_limit
+        cfg.overload.retry_after = 2.0
+        engine = _engine("burst")
+        engine.start()
+        factory = QueueFactory(cfg)
+        factory.create_queue_manager("standard", QueueType.STANDARD)
+        server = ApiServer(cfg, queue_factory=factory, engine=engine)
+        return cfg, engine, factory, server
+
+    def test_4x_burst_sheds_with_explicit_429_and_retry_after(self):
+        """A 4× overload burst: everything past the backlog limit gets
+        an explicit 429 with Retry-After; everything admitted
+        completes once workers drain the queue; nothing vanishes."""
+        cfg, engine, factory, server = self._burst_stack(depth_limit=8)
+        checker = InvariantChecker()
+        port = server.start(host="127.0.0.1", port=0)
+        accepted, shed = [], []
+        try:
+            for i in range(32):                       # 4× the limit
+                body = json.dumps({"id": f"b{i}", "content": f"burst {i}",
+                                   "user_id": "u"}).encode()
+                checker.submitted(f"b{i}")
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v1/messages",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        assert resp.status == 202
+                        accepted.append(f"b{i}")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 429, e.code
+                    payload = json.loads(e.read())
+                    assert "retry_after" in payload
+                    assert int(e.headers["Retry-After"]) >= 1
+                    checker.shed(f"b{i}", 429)
+                    shed.append(f"b{i}")
+            assert len(accepted) == 8                 # the limit held
+            assert len(shed) == 24                    # all EXPLICIT
+            # Drain: start workers; every admitted message completes.
+            mgr = factory.get_queue_manager("standard")
+            orig_complete = mgr.complete_message
+
+            def complete(m, t=0.0, q=None):
+                checker.completed(m.id)
+                orig_complete(m, t, q)
+
+            mgr.complete_message = complete
+            factory.create_workers("standard", 2, engine.process_fn)
+            _await_terminal(checker, 32)
+        finally:
+            server.stop()
+            factory.stop_all()
+            engine.stop()
+        checker.check()
+        assert server.shedder.get_stats()["shed"]["backlog"] == 24
+
+    def test_engine_down_sheds_503_with_retry_after(self):
+        cfg, engine, factory, server = self._burst_stack()
+        try:
+            engine.stop()                     # replica's engine is gone
+            status, payload, _ = server.dispatch(
+                "POST", "/api/v1/messages",
+                json.dumps({"content": "x", "user_id": "u"}).encode())
+            assert status == 503
+            assert "engine_down" in payload["error"] \
+                or "engine" in payload["error"]
+            assert payload["retry_after"] >= 0.5
+            assert server.shedder.get_stats()["shed"]["engine_down"] == 1
+        finally:
+            server.stop()
+            factory.stop_all()
+
+
+class TestOffSwitchEquivalence:
+    """Acceptance: chaos.enabled=false + overload.enabled=false ⇒
+    byte-identical token streams and scheduling to the pre-PR code."""
+
+    def _scenario(self):
+        engine = _engine("equiv")
+        prios = [Priority.REALTIME, Priority.HIGH, Priority.NORMAL,
+                 Priority.LOW]
+        handles = [engine.submit(GenRequest(
+            id=f"e{i}", prompt=f"equivalence payload {i} " * (1 + i % 3),
+            priority=prios[i % 4], max_new_tokens=16))
+            for i in range(8)]
+        engine.run_until_idle()
+        return [(h.request.id, h.result.finish_reason,
+                 tuple(h.result.tokens), h.result.text)
+                for h in handles]
+
+    def test_disabled_chaos_is_byte_identical(self):
+        chaos.configure(None)                         # pre-PR behavior
+        baseline = self._scenario()
+        # Off-switch with rules CONFIGURED: still no injector at all.
+        chaos.configure(ChaosConfig(enabled=False, faults=[
+            {"point": "engine.step", "kind": "crash"},
+            {"point": "engine.hbm_alloc", "kind": "error"}]))
+        assert chaos.get_injector() is None
+        assert self._scenario() == baseline
+        # Armed injector whose rules never match: token streams and
+        # scheduling still identical (fault points are pass-through).
+        chaos.configure(ChaosConfig(enabled=True, seed=5, faults=[
+            {"point": "no.such.point", "kind": "error"}]))
+        assert chaos.get_injector() is not None
+        assert self._scenario() == baseline
+
+    def test_disabled_overload_builds_no_shedder(self):
+        cfg = default_config()
+        cfg.overload.enabled = False
+        server = ApiServer(cfg)
+        assert server.shedder is None       # submit path untouched
+        cfg2 = default_config()
+        assert ApiServer(cfg2).shedder is not None
+
+
+class TestSupervisorEdgeCases:
+    def test_give_up_still_recovers_final_crash_in_flight(self):
+        """When the crash-loop bound trips, the FINAL crash's in-flight
+        handles must still be failed over — parked workers must not
+        wait out their full deadlines against a permanently-down
+        engine."""
+        _arm(41, {"point": "engine.step", "kind": "crash"})
+        engine = _engine("giveup")
+        sup = EngineSupervisor(
+            engine, config=SupervisorConfig(max_restarts=0),
+            enable_metrics=False)
+        h = engine.submit(GenRequest(id="g0", prompt="doomed",
+                                     max_new_tokens=8))
+        engine.start()
+        deadline = time.time() + 5.0
+        while engine.running and time.time() < deadline:
+            time.sleep(0.01)
+        assert not engine.running
+        assert not sup.check_once()        # gives up (max_restarts=0)…
+        assert sup.gave_up
+        assert h.wait(2.0)                 # …but the handle was failed
+        assert h.result.finish_reason == "error"
+        assert sup.recovered_total == 1
+
+    def test_deliberate_stop_is_not_resurrected(self):
+        """engine.stop() mid-supervision must never be 'recovered' as a
+        crash: the stop flag marks the death as intentional."""
+        inj = _arm(42)
+        engine = _engine("stopping")
+        engine.start()
+        sup = EngineSupervisor(engine, config=SupervisorConfig(),
+                               enable_metrics=False)
+        # Simulate the stop()-join window: stop flag set, loop thread
+        # dead, _thread not yet None.
+        inj.add_rule("engine.step", kind="crash", times=1)
+        deadline = time.time() + 5.0
+        while engine.running and time.time() < deadline:
+            time.sleep(0.01)
+        assert not engine.running
+        engine._stop.set()                 # deliberate-stop marker
+        assert not sup.check_once()
+        assert sup.restarts == 0
+        assert not engine.running          # NOT resurrected
+        engine.stop()

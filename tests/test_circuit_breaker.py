@@ -1,0 +1,179 @@
+"""Per-endpoint circuit breaker (loadbalancer/circuit_breaker.py,
+docs/robustness.md): trip threshold, jittered exponential backoff,
+half-open single-probe arbitration, and the selection-time
+``blocked()`` check that never consumes the probe slot."""
+
+from __future__ import annotations
+
+from llmq_tpu.core.clock import FakeClock
+from llmq_tpu.loadbalancer.circuit_breaker import (BreakerBoard,
+                                                   BreakerState,
+                                                   CircuitBreaker)
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("base_backoff", 1.0)
+    kw.setdefault("max_backoff", 8.0)
+    kw.setdefault("jitter", 0.0)          # exact timings in tests
+    return CircuitBreaker("ep0", clock=clock, seed=42, **kw)
+
+
+class TestStateMachine:
+    def test_trips_on_consecutive_failures_only(self):
+        clock = FakeClock()
+        br = _breaker(clock)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()               # streak reset
+        br.record_failure()
+        br.record_failure()
+        assert br.state == BreakerState.CLOSED
+        br.record_failure()               # 3rd consecutive
+        assert br.state == BreakerState.OPEN
+        assert br.trips == 1
+        assert not br.allow()
+
+    def test_half_open_grants_one_probe_then_closes_on_success(self):
+        clock = FakeClock()
+        br = _breaker(clock)
+        for _ in range(3):
+            br.record_failure()
+        assert br.retry_in() > 0
+        clock.advance(1.01)               # backoff elapsed
+        assert br.allow()                 # the probe slot
+        assert br.state == BreakerState.HALF_OPEN
+        assert not br.allow()             # second caller refused
+        br.record_success()
+        assert br.state == BreakerState.CLOSED
+        assert br.allow()
+
+    def test_failed_probe_reopens_with_doubled_backoff(self):
+        clock = FakeClock()
+        br = _breaker(clock)
+        for _ in range(3):
+            br.record_failure()
+        first_window = br.retry_in()
+        clock.advance(first_window + 0.01)
+        assert br.allow()                 # probe
+        br.record_failure()               # probe failed
+        assert br.state == BreakerState.OPEN
+        assert br.trips == 2
+        assert br.retry_in() > first_window * 1.5   # doubled (no jitter)
+
+    def test_backoff_caps_at_max(self):
+        clock = FakeClock()
+        br = _breaker(clock, max_backoff=4.0)
+        for _ in range(3):
+            br.record_failure()
+        for _ in range(6):                # keep failing probes
+            clock.advance(br.retry_in() + 0.01)
+            assert br.allow()
+            br.record_failure()
+        assert br.retry_in() <= 4.0 + 1e-6
+
+    def test_jitter_bounded_and_deterministic_per_seed(self):
+        windows = []
+        for _ in range(2):
+            clock = FakeClock()
+            br = CircuitBreaker("epj", clock=clock, seed=7,
+                                failure_threshold=1, base_backoff=10.0,
+                                jitter=0.2)
+            br.record_failure()
+            windows.append(br.retry_in())
+        assert windows[0] == windows[1]           # same seed, same draw
+        assert 8.0 <= windows[0] <= 12.0          # ±20% of 10s
+
+
+class TestBlockedVsAllow:
+    def test_blocked_never_consumes_the_probe_slot(self):
+        clock = FakeClock()
+        br = _breaker(clock, failure_threshold=1)
+        br.record_failure()
+        assert br.blocked()
+        clock.advance(1.01)
+        # Selection may scan the endpoint many times without eating
+        # the probe slot...
+        for _ in range(5):
+            assert not br.blocked()
+        # ...which is still there for the actual dispatch gate.
+        assert br.allow()
+        assert br.state == BreakerState.HALF_OPEN
+        # Probe in flight → selection skips it again.
+        assert br.blocked()
+
+
+class TestBoard:
+    def test_board_disabled_is_transparent(self):
+        class Cfg:
+            enabled = False
+        board = BreakerBoard(Cfg(), enable_metrics=False)
+        for _ in range(10):
+            board.record("e1", ok=False)
+        assert board.allow("e1")
+        assert not board.blocked("e1")
+
+    def test_board_trip_counts_and_stats(self):
+        board = BreakerBoard(None, enable_metrics=False)
+        for _ in range(3):
+            board.record("e1", ok=False)
+        assert board.blocked("e1")
+        assert not board.blocked("e2")    # unknown endpoint unaffected
+        stats = board.get_stats()
+        assert stats["e1"]["state"] == "open"
+        assert stats["e1"]["trips"] == 1
+
+
+class TestTimeoutNeutrality:
+    def test_record_timeout_releases_probe_slot_without_verdict(self):
+        """A probe dispatch that ends in a deadline miss must release
+        the half-open slot (or the endpoint is stuck out of rotation
+        forever) while counting neither success nor failure."""
+        clock = FakeClock()
+        br = _breaker(clock, failure_threshold=1)
+        br.record_failure()               # OPEN
+        clock.advance(1.01)
+        assert br.allow()                 # probe slot taken
+        assert not br.allow()
+        br.record_timeout()               # probe timed out: no verdict
+        assert br.state == BreakerState.HALF_OPEN
+        assert br.trips == 1              # not a failure
+        assert br.allow()                 # slot re-granted
+        br.record_success()
+        assert br.state == BreakerState.CLOSED
+
+    def test_record_timeout_is_noop_when_closed(self):
+        clock = FakeClock()
+        br = _breaker(clock)
+        br.record_failure()
+        br.record_timeout()
+        assert br.state == BreakerState.CLOSED
+        assert br.consecutive_failures == 1
+
+
+class TestProbeGradeSuccess:
+    def test_health_probe_cannot_close_an_open_breaker(self):
+        """A replica can serve /health 200 while failing every
+        dispatch (bad weights, full disk): the periodic health probe's
+        success must not close an OPEN breaker or reset the backoff
+        ladder — only a successful DISPATCH earns re-admission."""
+        clock = FakeClock()
+        br = _breaker(clock, failure_threshold=2)
+        br.record_failure()
+        br.record_probe_success()          # CLOSED: clears the streak
+        assert br.consecutive_failures == 0
+        br.record_failure()
+        br.record_failure()                # trips
+        assert br.state == BreakerState.OPEN
+        first_window = br.retry_in()
+        br.record_probe_success()          # /health 200 mid-backoff
+        assert br.state == BreakerState.OPEN      # NOT closed
+        assert br.retry_in() == first_window      # ladder untouched
+        # Half-open probe arbitration untouched by health probes too.
+        clock.advance(first_window + 0.01)
+        assert br.allow()
+        br.record_probe_success()
+        assert br.state == BreakerState.HALF_OPEN
+        br.record_failure()                # dispatch probe failed
+        assert br.state == BreakerState.OPEN
+        assert br.retry_in() > first_window * 1.5  # ladder DID double

@@ -468,3 +468,133 @@ def test_serve_sigterm_drains_before_exit():
     finally:
         if p.poll() is None:
             p.kill()
+
+
+# -- robustness: drain-during-failover race + circuit breakers ------------
+
+
+class _FakeEngine:
+    """Minimal in-process engine double for router-policy tests."""
+
+    def __init__(self, name: str, fail: bool = False) -> None:
+        self.name = name
+        self.fail = fail
+        self.calls = []
+        self.on_call = None
+
+    def healthy(self) -> bool:
+        return True
+
+    def process_fn(self, ctx, msg: Message) -> None:
+        self.calls.append(msg.id)
+        if self.on_call is not None:
+            self.on_call()
+        if self.fail:
+            raise RuntimeError(f"{self.name} exploded")
+        msg.response = f"ok-{self.name}"
+
+
+def _policy_router(engines, **ccfg) -> ClusterRouter:
+    lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                         health_check_interval=0.0))
+    router = ClusterRouter(lb, config=ClusterConfig(**ccfg),
+                           enable_metrics=False)
+    for e in engines:
+        router.register_engine(e)
+    return router
+
+
+class TestDrainDuringFailoverRace:
+    def test_draining_failover_target_is_skipped(self):
+        """A replica that enters DRAINING while it is the failover
+        target must NOT receive the retried message — it lands on a
+        third replica instead (satellite; the drain contract says no
+        NEW dispatch, and a failover retry is new dispatch)."""
+        a = _FakeEngine("a", fail=True)
+        b = _FakeEngine("b")
+        c = _FakeEngine("c")
+        router = _policy_router([a, b, c], failover_retries=2)
+        # b drains WHILE the dispatch to a is still in flight — the
+        # exact race: at selection time b was healthy, at failover
+        # re-pick time it is DRAINING.
+        a.on_call = lambda: router.lb.set_draining("b", True)
+        msg = Message(id="race0", content="x", user_id="u", timeout=10.0)
+        router.process_fn(None, msg)
+        assert msg.metadata["endpoint_id"] == "c"
+        assert msg.response == "ok-c"
+        assert b.calls == []               # the draining target saw nothing
+        assert a.calls == ["race0"]
+
+    def test_no_third_replica_lands_in_dlq_never_vanishes(self):
+        """Same race with only two replicas: the dispatch must surface
+        an error to the worker path and the message must land in the
+        DLQ — never silently vanish."""
+        from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
+        from llmq_tpu.queueing.queue_manager import QueueManager
+        from llmq_tpu.queueing.worker import Worker
+
+        a = _FakeEngine("a", fail=True)
+        b = _FakeEngine("b")
+        router = _policy_router([a, b], failover_retries=2)
+        a.on_call = lambda: router.lb.set_draining("b", True)
+        cfg = default_config()
+        cfg.queue.enable_metrics = False
+        mgr = QueueManager("drainrace", config=cfg, enable_metrics=False)
+        dlq = DeadLetterQueue(name="drainrace-dlq")
+        worker = Worker("w", mgr, router.process_fn,
+                        dead_letter_queue=dlq)
+        msg = Message(id="race1", content="x", user_id="u", timeout=10.0)
+        msg.max_retries = 0                # first failure is permanent
+        mgr.push_message(msg)
+        worker.process_batch()             # synchronous dispatch
+        assert b.calls == []
+        assert dlq.size() == 1             # parked, not lost
+        assert dlq.get("race1").message.id == "race1"
+        mgr.stop()
+
+
+class TestRouterBreakers:
+    def test_open_breaker_takes_endpoint_out_of_rotation(self):
+        from llmq_tpu.core.config import BreakerConfig
+        a = _FakeEngine("a", fail=True)
+        b = _FakeEngine("b")
+        router = _policy_router(
+            [a, b], failover_retries=2,
+            breaker=BreakerConfig(failure_threshold=2,
+                                  base_backoff=30.0, jitter=0.0))
+        for i in range(2):                 # two failures trip a's breaker
+            m = Message(id=f"t{i}", content="x", user_id="u",
+                        timeout=10.0)
+            router.process_fn(None, m)
+            assert m.response == "ok-b"    # failed over each time
+        assert router.breakers.blocked("a")
+        calls_before = len(a.calls)
+        for i in range(4):                 # a is skipped at SELECTION now
+            m = Message(id=f"s{i}", content="x", user_id="u",
+                        timeout=10.0)
+            router.process_fn(None, m)
+            assert m.metadata["endpoint_id"] == "b"
+        assert len(a.calls) == calls_before
+        assert router.get_stats()["breakers"]["a"]["state"] == "open"
+
+    def test_half_open_probe_recovers_endpoint(self):
+        from llmq_tpu.core.config import BreakerConfig
+        a = _FakeEngine("a", fail=True)
+        b = _FakeEngine("b")
+        router = _policy_router(
+            [a, b], failover_retries=2,
+            breaker=BreakerConfig(failure_threshold=1,
+                                  base_backoff=0.05, jitter=0.0))
+        m = Message(id="p0", content="x", user_id="u", timeout=10.0)
+        router.process_fn(None, m)         # trips a
+        assert router.breakers.blocked("a")
+        a.fail = False                     # replica recovered
+        time.sleep(0.08)                   # backoff elapses
+        seen = set()
+        for i in range(8):                 # probe dispatch re-admits a
+            m = Message(id=f"h{i}", content="x", user_id="u",
+                        timeout=10.0)
+            router.process_fn(None, m)
+            seen.add(m.metadata["endpoint_id"])
+        assert "a" in seen                 # closed again, serving
+        assert router.get_stats()["breakers"]["a"]["state"] == "closed"

@@ -86,3 +86,31 @@ class TestRequeue:
         dlq.push(Message(), "r", "q")
         assert dlq.clear() == 1
         assert dlq.size() == 0
+
+
+class TestHandlerIsolation:
+    """Satellite: a raising handler must not abort push, must not skip
+    the remaining handlers, and must be COUNTED
+    (dlq_handler_errors_total)."""
+
+    def test_raising_handler_isolated_and_counted(self):
+        from llmq_tpu.metrics.registry import get_metrics
+        dlq = DeadLetterQueue(max_size=10, name="handler-iso")
+        seen = []
+
+        def bad(item):
+            raise RuntimeError("alerting hook exploded")
+
+        def good(item):
+            seen.append(item.message.id)
+
+        dlq.add_handler(bad)
+        dlq.add_handler(good)
+        metric = get_metrics().dlq_handler_errors.labels("handler-iso")
+        before = metric._value.get()
+        msg = Message(id="h1", content="x", user_id="u")
+        item = dlq.push(msg, "boom", "normal")   # must NOT raise
+        assert item.message.id == "h1"
+        assert seen == ["h1"]                    # later handler still ran
+        assert dlq.size() == 1                   # stored despite the raise
+        assert metric._value.get() == before + 1

@@ -194,3 +194,108 @@ def test_two_os_process_serve_failover():
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+# -- probe cause classification + circuit breaker (docs/robustness.md) --
+
+
+def _free_port_url() -> str:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def test_probe_refused_fast_fails_and_feeds_breaker():
+    """Connection-refused (nothing listens there) is the strongest
+    down-signal — it must be classified as such and feed the breaker,
+    so the data path stops paying connect timeouts between health
+    ticks."""
+    from llmq_tpu.loadbalancer.circuit_breaker import (BreakerState,
+                                                       CircuitBreaker)
+    url = _free_port_url()
+    br = CircuitBreaker(url, failure_threshold=2, base_backoff=0.05)
+    client = HttpEngineClient(url, probe_timeout=0.5, breaker=br)
+    assert client.probe() == "refused"
+    assert br.consecutive_failures == 1
+    assert client.probe() == "refused"
+    assert br.state == BreakerState.OPEN   # tripped from probes alone
+    assert not client.healthy()
+
+
+def test_probe_draining_and_stopped_are_not_endpoint_faults():
+    """A draining peer and a stopped engine are deliberate states, not
+    breaker-worthy faults — and each gets its own verdict."""
+    from llmq_tpu.loadbalancer.circuit_breaker import CircuitBreaker
+    engines, servers, urls = _serve_pair()
+    try:
+        br = CircuitBreaker(urls[0], failure_threshold=1)
+        client = HttpEngineClient(urls[0], breaker=br)
+        assert client.probe() == "ok"
+        servers[0].draining = True
+        assert client.probe() == "draining"
+        assert not client.healthy()
+        servers[0].draining = False
+        engines[0].stop()
+        assert client.probe() == "stopped"
+        assert not client.healthy()
+        assert br.consecutive_failures == 0   # breaker untouched
+    finally:
+        for s in servers:
+            s.stop()
+        for e in engines:
+            if e.running:
+                e.stop()
+
+
+def test_expired_deadline_raises_timeout_without_dispatching():
+    """An already-expired context must raise TimeoutError BEFORE any
+    network I/O: the URL points at a closed port, so an attempted
+    dispatch would surface as RuntimeError('unreachable') instead."""
+    client = HttpEngineClient(_free_port_url())
+
+    class _Expired:
+        def remaining(self):
+            return -0.5
+
+    with pytest.raises(TimeoutError):
+        client.process_fn(_Expired(), Message(id="dx", content="x",
+                                              user_id="u"))
+
+
+def test_open_breaker_fast_fails_dispatch_without_io():
+    from llmq_tpu.loadbalancer.circuit_breaker import (CircuitBreaker,
+                                                       CircuitOpenError)
+    url = _free_port_url()
+    br = CircuitBreaker(url, failure_threshold=1, base_backoff=30.0)
+    br.record_failure()                   # OPEN for ~30s
+    client = HttpEngineClient(url, timeout=30.0, breaker=br)
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        client.process_fn(None, Message(id="cb", content="x",
+                                        user_id="u"))
+    assert time.monotonic() - t0 < 0.5    # no socket was opened
+
+
+def test_probe_success_resets_consecutive_failures():
+    """Sparse refusals (one per replica restart, days apart) must not
+    read as consecutive: a clean probe records success."""
+    from llmq_tpu.loadbalancer.circuit_breaker import CircuitBreaker
+    engines, servers, urls = _serve_pair()
+    dead_url = _free_port_url()
+    try:
+        br = CircuitBreaker(urls[0], failure_threshold=2)
+        up = HttpEngineClient(urls[0], breaker=br)
+        down = HttpEngineClient(dead_url, probe_timeout=0.5, breaker=br)
+        assert down.probe() == "refused"
+        assert br.consecutive_failures == 1
+        assert up.probe() == "ok"          # healthy gap resets the streak
+        assert br.consecutive_failures == 0
+        assert down.probe() == "refused"   # 2 sparse refusals: no trip
+        assert br.state.value == "closed"
+    finally:
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()
