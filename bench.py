@@ -267,19 +267,9 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
 
 # -- 3. single-chip decode (BASELINE config #2) -------------------------------
 
-_PEAK_BF16 = {
-    # device_kind substring → peak bf16 TFLOP/s
-    "v5 lite": 197e12, "v5e": 197e12,
-    "v5p": 459e12, "v4": 275e12, "v6": 918e12,
-}
-
-
-def _peak_flops(kind: str) -> float:
-    kl = kind.lower()
-    for k, v in _PEAK_BF16.items():
-        if k in kl:
-            return v
-    return 197e12
+# MFU / RTT math lives in llmq_tpu/observability/device.py now (the
+# serving path exports the same numbers live); bench imports the shared
+# implementation instead of keeping its own copy.
 
 
 def _enable_bench_cache() -> None:
@@ -292,26 +282,6 @@ def _enable_bench_cache() -> None:
     cache = os.environ.get("LLMQ_BENCH_CACHE_DIR",
                            os.path.join(REPO, ".jax_cache"))
     enable_compilation_cache(cache)
-
-
-def _measure_rtt() -> float:
-    """Host↔device round-trip floor: every synchronous fetch pays this
-    (≈0.1-0.2 ms on a TPU VM; ~70-110 ms through a tunneled dev
-    runtime). End-to-end latency numbers bottom out at 1-2 RTTs per
-    request — record it so they are interpretable."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    f = jax.jit(lambda x: x + 1)
-    x = jnp.zeros(8, jnp.int32)
-    np.asarray(f(x))
-    rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        np.asarray(f(x))
-        rtts.append(time.perf_counter() - t0)
-    return sorted(rtts)[len(rtts) // 2] * 1e3
 
 
 def bench_tpu_decode(model_name: str, batch: int, steps: int,
@@ -330,8 +300,9 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
     from llmq_tpu.engine.executor import JaxExecutor
     from llmq_tpu.models.llama import (get_config, init_params,
                                        init_params_quantized, param_count)
+    from llmq_tpu.observability.device import decode_mfu, measure_rtt
 
-    rtt_ms = _measure_rtt()
+    rtt_ms = measure_rtt()
     log(f"[tpu] host<->device RTT ~{rtt_ms:.1f}ms")
 
     max_seq = int(os.environ.get("LLMQ_BENCH_SEQ", "1024"))
@@ -367,7 +338,10 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
                      num_pages=num_pages, chunk_size=chunk,
                      prefill_buckets=[128, 512], eos_id=-1,
                      cache_dtype=(jnp.int8 if kv_quant == "int8"
-                                  else None))
+                                  else None),
+                     # Bench discipline: telemetry host-side only, no
+                     # prometheus writes on the measured path.
+                     telemetry_metrics=False)
     t0 = time.perf_counter()
     ex.warmup()
     compile_s = time.perf_counter() - t0
@@ -438,10 +412,9 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
     n_tok = n_calls * chunk
     step_ms = dt / n_tok * 1e3
     tps = batch * n_tok / dt
-    peak = _peak_flops(dev.device_kind)
-    if quant == "int8":
-        peak *= 2          # v5e int8 MXU path has 2x the bf16 FLOPs
-    mfu = tps * 2 * n_params / peak
+    # Shared implementation (observability/device.py): int8 doubles the
+    # v5e MXU peak, same convention the live serving gauge uses.
+    mfu = decode_mfu(tps, n_params, dev.device_kind, quant=quant)
     log(f"[tpu] decode: {step_ms:.2f} ms/token-step, {tps:,.0f} tok/s "
         f"(B={batch}, chunk={chunk}), MFU={mfu*100:.2f}%  | "
         f"prefill {prefill_tps:,.0f} tok/s serialized, "
@@ -634,8 +607,9 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     from llmq_tpu.engine.tokenizer import ByteTokenizer
     from llmq_tpu.models.llama import (get_config, init_params,
                                        init_params_quantized)
+    from llmq_tpu.observability.device import measure_rtt
 
-    rtt_ms = _measure_rtt()
+    rtt_ms = measure_rtt()
     tok = ByteTokenizer()
     max_seq = 512
     cfg = get_config(model_name, max_seq_len=max_seq)
@@ -670,7 +644,11 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                                   else None),
                      mixed_prefill_slices=(mb.max_slices if mb else 0),
                      mixed_slice_tokens=(mb.slice_tokens if mb else 0),
-                     eos_id=tok.eos_id)
+                     eos_id=tok.eos_id,
+                     # Matches the engine's enable_metrics=False below:
+                     # telemetry stays host-side (read per rate point),
+                     # no prometheus on the bench path.
+                     telemetry_metrics=False)
     log(f"[poisson-tpu] warmup {cfg.name} {quant or 'bf16'} "
         f"(kv={kv_quant or 'bf16'}, page={page_size}, "
         f"{num_pages} pages, {slots} slots) ...")
@@ -725,6 +703,12 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                engine.cached_prefill_tokens_total)
         mx0 = (engine.mixed_steps, engine.mixed_prefill_tokens_total,
                engine.prefill_stall_events, engine.prefill_stall_ms_total)
+        # Step-decomposition deltas, same discipline as the stall/cache
+        # counters above: snapshot the cumulative totals now so the
+        # point reports THIS phase's means, not lifetime averages that
+        # fold in the warm burst and every earlier rate point.
+        dev0_steps = ((engine.get_stats().get("device") or {})
+                      .get("steps") or {})
         while time.perf_counter() - t_start < dur:
             now = time.perf_counter()
             if now < next_arrival:
@@ -800,6 +784,35 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
             log(f"[poisson-tpu@{rate:g}] prefix cache: "
                 f"hit_rate={point['prefix_cache_hit_rate']:.2f} "
                 f"cached_tokens={point['cached_prefill_tokens']}")
+        # Live device telemetry for this point, read from the SAME
+        # registry the serving path exports (observability/device.py)
+        # instead of recomputed ad hoc: trailing-window decode rate +
+        # MFU as of the phase end, PER-PHASE step-decomposition means
+        # (cumulative-total deltas against the phase-start snapshot),
+        # and the HBM/pool snapshot.
+        dev = engine.get_stats().get("device") or {}
+        steps = dev.get("steps") or {}
+
+        def _phase_mean(leg: str):
+            cur = steps.get(leg) or {}
+            pre = dev0_steps.get(leg) or {}
+            n = cur.get("count", 0) - pre.get("count", 0)
+            if n <= 0:
+                return None
+            return round((cur.get("total_ms", 0.0)
+                          - pre.get("total_ms", 0.0)) / n, 3)
+
+        point["device"] = {
+            "decode_tokens_per_s": dev.get("decode_tokens_per_s"),
+            "mfu_pct": dev.get("mfu_pct"),
+            "host_device_rtt_ms": dev.get("host_device_rtt_ms"),
+            "hbm": dev.get("hbm"),
+            "step_chunks": (steps.get("count", 0)
+                            - dev0_steps.get("count", 0)),
+            "step_mean_ms": {
+                k: _phase_mean(k)
+                for k in ("dispatch_ms", "device_ms", "readback_ms")},
+        }
         # The tunnel-free projection: the measured critical path carries
         # ~2 host↔device round-trips (prefill-sample fetch + chunk
         # fetch — see decomp first_sample/tail); on a real TPU VM the

@@ -248,11 +248,14 @@ class ApiServer:
         r("POST", f"{v1}/endpoints/:id/drain", self.drain_endpoint)
         r("DELETE", f"{v1}/endpoints/:id", self.delete_endpoint)
         r("GET", f"{v1}/cluster/stats", self.get_cluster_stats)
+        r("GET", f"{v1}/cluster/overview", self.get_cluster_overview)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
         r("POST", f"{v1}/generate", self.generate_sync)
         r("GET", f"{v1}/requests/:id/trace", self.get_request_trace)
         adm = f"{v1}/admin"
         r("GET", f"{adm}/flightrecorder", self.get_flight_recorder)
+        r("POST", f"{adm}/profile", self.start_profile)
+        r("GET", f"{adm}/profile", self.get_profile_status)
         r("POST", f"{adm}/drain", self.drain_self)
         r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
         r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
@@ -864,7 +867,29 @@ class ApiServer:
     def get_engine_stats(self, req: _Request) -> Tuple[int, Any]:
         if self.engine is None:
             raise ApiError(503, "engine not configured")
-        return 200, self.engine.get_stats()
+        out = self.engine.get_stats()
+        try:
+            # Process-level SLO burn rates ride the engine stats
+            # payload (the cluster overview rolls them up per replica).
+            # Drain the recorder's deferred feed first: this route must
+            # show real burn even when nothing is scraping /metrics —
+            # a broken scrape is exactly when an operator reads it.
+            from llmq_tpu.observability.recorder import get_recorder
+            from llmq_tpu.observability.slo import get_slo_tracker
+            get_recorder().flush_metrics()
+            out["slo"] = get_slo_tracker().snapshot()
+        except Exception:  # noqa: BLE001 — stats must not fail on SLO plane
+            pass
+        return 200, out
+
+    def get_cluster_overview(self, req: _Request) -> Tuple[int, Any]:
+        """Cluster-wide device-telemetry rollup: per-replica MFU, tok/s,
+        HBM and step decomposition through the existing transport
+        (docs/observability.md "Device telemetry")."""
+        if self.cluster_router is None:
+            raise ApiError(503, "cluster router not configured "
+                                "(set cluster.peers / --peers)")
+        return 200, self.cluster_router.overview()
 
     def generate_sync(self, req: _Request) -> Tuple[int, Any]:
         """Synchronous inference RPC — the server half of the
@@ -964,6 +989,39 @@ class ApiServer:
         }
 
     # -- admin ---------------------------------------------------------------
+
+    def start_profile(self, req: _Request) -> Tuple[int, Any]:
+        """On-demand bounded ``jax.profiler`` capture
+        (docs/observability.md "Device telemetry"): kicks off a
+        background trace via the ``utils/profiling.trace`` hook and
+        answers 202 with the trace path immediately. SINGLE-FLIGHT:
+        the profiler session is process-global, so a concurrent
+        capture answers 409 with the active capture's path."""
+        from llmq_tpu.observability import device
+        data = req.json() if self._body_present(req) else {}
+        try:
+            duration_s = float(data.get("duration_ms", 1000.0)) / 1e3
+        except (TypeError, ValueError):
+            raise ApiError(400, "duration_ms must be a number") from None
+        label = re.sub(r"[^\w.-]", "_",
+                       str(data.get("label") or "ondemand"))[:64]
+        try:
+            # Output location is SERVER-controlled (LLMQ_TRACE_DIR or a
+            # fresh tempdir) — a request-body path would let any API
+            # caller write trace trees to arbitrary filesystem
+            # locations; every other on-disk path here comes from
+            # operator env/config, and this route is no exception.
+            import os as _os
+            info = device.start_profile(
+                duration_s=duration_s, label=label,
+                base_dir=_os.environ.get("LLMQ_TRACE_DIR") or None)
+        except device.ProfileInProgress as e:
+            raise ApiError(409, str(e)) from None
+        return 202, info
+
+    def get_profile_status(self, req: _Request) -> Tuple[int, Any]:
+        from llmq_tpu.observability import device
+        return 200, device.profile_status()
 
     def add_priority_rule(self, req: _Request) -> Tuple[int, Any]:
         if self.preprocessor is None:

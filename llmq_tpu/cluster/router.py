@@ -374,6 +374,112 @@ class ClusterRouter(EngineRouter):
 
     # -- stats ---------------------------------------------------------------
 
+    def overview(self) -> Dict:
+        """Cluster-wide device-telemetry rollup
+        (``GET /api/v1/cluster/overview``): every endpoint's engine +
+        device block — local engines read in-process, remote replicas
+        over the existing transport (``HttpEngineClient.engine_stats``,
+        probe-grade timeout). A replica that fails to answer degrades
+        to an ``error`` entry instead of failing the rollup — the
+        overview is exactly for the moments when some replica is
+        misbehaving. Remote fetches fan out CONCURRENTLY, so the
+        route's latency is bounded by ~one probe timeout even with
+        several black-holed replicas, not timeout × dead count."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        endpoints = self.lb.endpoints()
+
+        def fetch(ep) -> Dict:
+            entry: Dict = {
+                "id": ep.id,
+                "url": getattr(ep, "url", ""),
+                "status": str(getattr(getattr(ep, "status", ""), "value",
+                                      getattr(ep, "status", ""))),
+            }
+            eng = self.engine_for(ep)
+            stats = None
+            if eng is None:
+                entry["error"] = "no engine/transport attached"
+            else:
+                remote = getattr(eng, "engine_stats", None)
+                try:
+                    if remote is not None:
+                        stats = remote()
+                    elif hasattr(eng, "get_stats"):
+                        stats = eng.get_stats()
+                except Exception as e:  # noqa: BLE001 — degrade per replica
+                    entry["error"] = f"{type(e).__name__}: {e}"
+            if stats:
+                # Only attach a device block that actually has content:
+                # "reporting" counts these, and an older replica
+                # without the telemetry plane must not inflate it.
+                dev = stats.get("device")
+                if dev:
+                    entry["device"] = dev
+                entry["engine"] = {
+                    k: stats.get(k)
+                    for k in ("name", "slots", "active", "pending",
+                              "decode_steps", "tokens_generated",
+                              "kv_pages_used", "kv_pages_total")}
+                if stats.get("slo") is not None:
+                    # Remote replicas attach their SLO snapshot to
+                    # engine/stats — roll it up per replica.
+                    entry["slo"] = stats["slo"]
+                elif remote is None:
+                    # LOCAL in-process engines only: their SLO plane is
+                    # THIS process's tracker (engine.get_stats has no
+                    # slo key — the api layer injects it for remotes).
+                    # Keyed on locality, not on a missing key: a remote
+                    # that reported no slo (older build, injection
+                    # failure) must not be dressed in the
+                    # coordinator's burn rates.
+                    try:
+                        from llmq_tpu.observability.recorder import \
+                            get_recorder
+                        from llmq_tpu.observability.slo import \
+                            get_slo_tracker
+                        # Drain the deferred feed first, exactly like
+                        # the /engine/stats route — the two admin
+                        # surfaces must agree even with no scraper.
+                        get_recorder().flush_metrics()
+                        entry["slo"] = get_slo_tracker().snapshot()
+                    except Exception:  # noqa: BLE001 — rollup survives
+                        pass
+            return entry
+
+        if endpoints:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(endpoints))) as pool:
+                replicas = list(pool.map(fetch, endpoints))
+        else:
+            replicas = []
+        agg_tok_s = 0.0
+        mfus = []
+        occupancies = []
+        for entry in replicas:
+            dev = entry.get("device")
+            if not dev:
+                continue
+            agg_tok_s += dev.get("decode_tokens_per_s") or 0.0
+            if dev.get("mfu_pct") is not None:
+                mfus.append(dev["mfu_pct"])
+            occ = (dev.get("hbm") or {}).get("kv_pool_occupancy")
+            if occ is not None:
+                occupancies.append(occ)
+        reporting = sum(1 for r in replicas if "device" in r)
+        return {
+            "replicas": replicas,
+            "aggregate": {
+                "endpoints": len(replicas),
+                "reporting": reporting,
+                "decode_tokens_per_s": round(agg_tok_s, 1),
+                "mean_mfu_pct": (round(sum(mfus) / len(mfus), 3)
+                                 if mfus else 0.0),
+                "max_kv_pool_occupancy": (round(max(occupancies), 4)
+                                          if occupancies else 0.0),
+            },
+        }
+
     def get_stats(self) -> Dict:
         with self._mu:
             hits, eligible = self.affinity_hits, self.affinity_eligible

@@ -284,6 +284,30 @@ class LoggingConfig:
 
 
 @dataclass
+class SloConfig:
+    """SLO targets + error-budget burn rates (observability/slo.py,
+    docs/observability.md "Device telemetry"). Burn rate 1.0 = spending
+    exactly the allowed error budget; deployments/alerts.yml pages on
+    fast burn over the short window, warns on slow burn over the long
+    one. FED by the flight recorder's metrics flush: requires
+    ``observability.enabled`` and ``emit_metrics`` — with either off
+    the tracker is force-disabled (and a warning logged) rather than
+    reporting 0 burn with no feed."""
+    enabled: bool = True
+    #: TTFT target (ms) every request is held to; <= 0 disables.
+    ttft_p99_ms: float = 2000.0
+    #: End-to-end target (ms) for REALTIME-tier requests (the
+    #: reference's 500 ms load-test gate); <= 0 disables.
+    realtime_p99_ms: float = 500.0
+    #: Promised success fraction (0.99 → 1 % error budget).
+    objective: float = 0.99
+    #: Rolling burn-rate windows in seconds (short = fast burn,
+    #: long = slow burn).
+    windows_s: List[float] = field(default_factory=lambda: [300.0,
+                                                            3600.0])
+
+
+@dataclass
 class ObservabilityConfig:
     """Request-lifecycle trace plane (llmq_tpu/observability/,
     docs/observability.md). ``enabled: false`` is a hard off-switch:
@@ -305,6 +329,8 @@ class ObservabilityConfig:
     #: request in the ``POST /api/v1/generate`` response so the
     #: gateway can stitch a cross-process timeline.
     propagate_trace: bool = True
+    #: SLO targets / burn-rate windows (observability/slo.py).
+    slo: SloConfig = field(default_factory=SloConfig)
 
 
 @dataclass

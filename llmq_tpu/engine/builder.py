@@ -127,7 +127,10 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             cache_dtype=(jnp.int8 if kv_quant == "int8" else None),
             mixed_prefill_slices=mixed_slices,
             mixed_slice_tokens=mixed_slice_tokens,
-            mesh=mesh)
+            mesh=mesh,
+            telemetry_name=name,
+            # Warmup runs before InferenceEngine can set the flag.
+            telemetry_metrics=metrics_on)
         if warmup:
             executor.warmup()
     else:

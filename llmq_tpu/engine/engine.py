@@ -42,6 +42,7 @@ import heapq
 import itertools
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -54,6 +55,7 @@ from llmq_tpu.engine.executor import Executor
 from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from llmq_tpu.metrics.registry import get_metrics
+from llmq_tpu.observability.device import get_device_telemetry
 from llmq_tpu.utils.logging import get_logger
 from llmq_tpu.utils.profiling import SpanRecorder
 
@@ -246,14 +248,20 @@ class _InflightChunk:
     snapshot of the prefill slices fused into the program — their
     handle.fetch() returns (decode tokens, slice first-tokens)."""
 
-    __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf")
+    __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf",
+                 "dispatch_s")
 
-    def __init__(self, handle, seqs, budgets, pf=None) -> None:
+    def __init__(self, handle, seqs, budgets, pf=None,
+                 dispatch_s: float = 0.0) -> None:
         self.handle = handle
         self.seqs = seqs          # List[Optional[_Sequence]], len B
         self.budgets = budgets    # np.ndarray (B,) int32
         self.fetch_box = None
         self.pf = pf              # List[(seq, n_tokens, final)] | None
+        #: Host-side assembly + dispatch seconds for this chunk — the
+        #: "dispatch" leg of the step decomposition; the device/readback
+        #: legs are measured at fetch (observability/device.py).
+        self.dispatch_s = dispatch_s
 
 
 @dataclass
@@ -310,6 +318,37 @@ class InferenceEngine:
         self._metrics = get_metrics() if enable_metrics else None
         # Per-engine recorder: stats must not mix spans across engines.
         self._prof = SpanRecorder()
+        #: Device telemetry plane (observability/device.py): step-time
+        #: decomposition, live tok/s + MFU, HBM accounting — shared by
+        #: name with the executor (compile-cache side) and read live by
+        #: /metrics, GET /api/v1/engine/stats and bench rate points.
+        self._telemetry = get_device_telemetry(name,
+                                               metrics=enable_metrics)
+        # Weak provider: the telemetry registry is process-lived; a
+        # strong ref to the engine would keep every test/bench engine
+        # (and its device arrays) alive forever.
+        _eng_ref = weakref.ref(self)
+
+        def _hbm_provider():
+            eng = _eng_ref()
+            return eng._hbm_snapshot() if eng is not None else None
+
+        self._telemetry.set_hbm_provider(_hbm_provider)
+        # Model identity for the MFU estimator. Skipped when already
+        # configured: a builder-constructed JaxExecutor shares this
+        # very instance (same name) and configured it in its own
+        # __init__ — repeating would walk param_count over the full
+        # tree a second time at startup.
+        info_fn = getattr(executor, "telemetry_info", None)
+        if info_fn is not None and self._telemetry.n_params == 0:
+            try:
+                self._telemetry.configure_model(**info_fn())
+            except Exception:  # noqa: BLE001 — telemetry must not block init
+                log.exception("telemetry model info failed for %s", name)
+        #: All tokens committed to sequences (device telemetry's live
+        #: decode-rate source; engine-local so metrics-off benches can
+        #: still read it).
+        self.tokens_generated_total = 0
 
         self.allocator = PageAllocator(self.spec.num_pages,
                                        self.spec.page_size)
@@ -1658,6 +1697,7 @@ class InferenceEngine:
         if (sum(n for *_, n in plan) + sum(n for *_, n in join_plan)
                 > self.allocator.available()):
             return None     # would require shedding → reconcile
+        t_asm = time.perf_counter()   # step decomposition: dispatch leg
         budgets = np.zeros(B, np.int32)
         block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
         temps = np.zeros(B, np.float32)
@@ -1681,11 +1721,13 @@ class InferenceEngine:
             handle = self.executor.decode_chunk_start(
                 None, None, block_tables, temps, budgets,
                 carry=infl.handle, overrides=overrides)
+        dispatch_s = time.perf_counter() - t_asm
         _prefetch(getattr(handle, "out", None))
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
-        infl_next = _InflightChunk(handle, seqs, budgets)
+        infl_next = _InflightChunk(handle, seqs, budgets,
+                                   dispatch_s=dispatch_s)
         self._start_fetch(infl_next)
         return infl_next
 
@@ -1734,8 +1776,11 @@ class InferenceEngine:
     def _start_fetch(self, infl: _InflightChunk) -> None:
         """Hand the chunk's blocking fetch to the fetcher thread (the
         D2H transfer itself was already queued by ``_prefetch`` at
-        dispatch)."""
-        infl.fetch_box = self._offload_fetch(infl.handle.fetch)
+        dispatch). The timed wrapper splits the wait into device
+        execute vs token readback — the fetch box then holds
+        ``(result, device_s, readback_s)``."""
+        infl.fetch_box = self._offload_fetch(
+            lambda: self._telemetry.timed_fetch(infl.handle))
 
     def _fetch_loop(self, q) -> None:
         while True:
@@ -1798,7 +1843,8 @@ class InferenceEngine:
         if box is None:
             t0 = time.perf_counter()
             with self._prof.span("engine.chunk_fetch"):
-                out = infl.handle.fetch()
+                out, device_s, readback_s = \
+                    self._telemetry.timed_fetch(infl.handle)
             dt = time.perf_counter() - t0
             if dt > 5.0:          # same stall threshold as _service_while
                 log.warning("blocking chunk fetch stalled %.1f s "
@@ -1810,10 +1856,11 @@ class InferenceEngine:
                 self._service_while(box["ev"])
             if box["err"] is not None:
                 raise box["err"]
-            out = box["out"]
+            out, device_s, readback_s = box["out"]
         pf_first = None
         if infl.pf is not None:
             out, pf_first = out      # mixed chunk: (decode, slice firsts)
+        tok0 = self.tokens_generated_total
         for slot in range(self.spec.batch_size):
             seq = infl.seqs[slot]
             if seq is None or seq.slot != slot:
@@ -1821,6 +1868,8 @@ class InferenceEngine:
             self._commit_row(seq, out[slot], int(infl.budgets[slot]))
         if infl.pf is not None:
             self._finish_mixed_prefills(infl.pf, pf_first)
+        self._telemetry.note_step(infl.dispatch_s, device_s, readback_s,
+                                  self.tokens_generated_total - tok0)
         self._set_gauges()
 
     def _budget_chunk_rows(self, chunk: int, rows) -> Dict[int, int]:
@@ -1896,6 +1945,7 @@ class InferenceEngine:
             self._set_gauges()
             return False
 
+        t_asm = time.perf_counter()   # step decomposition: dispatch leg
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
@@ -1922,16 +1972,19 @@ class InferenceEngine:
                                  joined=len(joining)):
                 handle = start_fn(tokens, positions, block_tables, temps,
                                   budgets, overrides=overrides)
+            dispatch_s = time.perf_counter() - t_asm
             _prefetch(getattr(handle, "out", None))
             seqs = [None] * B
             for seq in active + joining:
                 seqs[seq.slot] = seq
-            self._chunk_inflight = _InflightChunk(handle, seqs, budgets)
+            self._chunk_inflight = _InflightChunk(handle, seqs, budgets,
+                                                  dispatch_s=dispatch_s)
             self._start_fetch(self._chunk_inflight)
             self.steps += 1
             if self._metrics:
                 self._metrics.decode_steps.labels(self.name).inc()
             return True
+        t_call = time.perf_counter()
         with self._prof.span("engine.decode_chunk",
                              active=len(active), chunk=chunk):
             if chunk > 1 and hasattr(self.executor, "decode_chunk"):
@@ -1941,11 +1994,18 @@ class InferenceEngine:
             else:
                 out = self.executor.decode(tokens, positions, block_tables,
                                            temps)[:, None]
+        t_done = time.perf_counter()
+        out = np.asarray(out)        # readback fence (no-op for echo)
+        t_rb = time.perf_counter()
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
+        tok0 = self.tokens_generated_total
         for seq in active:
             self._commit_row(seq, out[seq.slot], int(budgets[seq.slot]))
+        self._telemetry.note_step(t_call - t_asm, t_done - t_call,
+                                  t_rb - t_done,
+                                  self.tokens_generated_total - tok0)
         self._set_gauges()
         return True
 
@@ -2010,6 +2070,7 @@ class InferenceEngine:
             # todo_ids fold into its rebuild stream).
             return self._decode_once()
 
+        t_asm = time.perf_counter()   # step decomposition: dispatch leg
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
@@ -2053,6 +2114,7 @@ class InferenceEngine:
                                  slices=len(pf), pf_tokens=packed):
                 handle = start_fn(tokens, positions, block_tables,
                                   temps, budgets, pf)
+            dispatch_s = time.perf_counter() - t_asm
             self._note_prefill_dispatch(
                 packed, time.perf_counter() - t0,
                 decode_active=bool(active), fused=True)
@@ -2064,7 +2126,8 @@ class InferenceEngine:
             for seq, _, _ in infl_pf:
                 seq.mixed_pending = True
             self._chunk_inflight = _InflightChunk(handle, seqs, budgets,
-                                                  pf=infl_pf)
+                                                  pf=infl_pf,
+                                                  dispatch_s=dispatch_s)
             self._start_fetch(self._chunk_inflight)
             self.steps += 1
             self.mixed_steps += 1
@@ -2078,19 +2141,25 @@ class InferenceEngine:
                              pf_tokens=packed):
             out, pf_first = self.executor.mixed_chunk(
                 tokens, positions, block_tables, temps, budgets, pf)
+        t_done = time.perf_counter()
+        out = np.asarray(out)        # readback fence (no-op for echo)
+        t_rb = time.perf_counter()
         self._note_prefill_dispatch(
-            packed, time.perf_counter() - t0,
+            packed, t_done - t0,
             decode_active=bool(active), fused=True)
         self.steps += 1
         self.mixed_steps += 1
         self.mixed_prefill_tokens_total += packed
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
+        tok0 = self.tokens_generated_total
         for seq in active:
             if seq.slot is not None:
                 self._commit_row(seq, out[seq.slot],
                                  int(budgets[seq.slot]))
         self._finish_mixed_prefills(infl_pf, pf_first)
+        self._telemetry.note_step(t0 - t_asm, t_done - t0, t_rb - t_done,
+                                  self.tokens_generated_total - tok0)
         self._set_gauges()
         return True
 
@@ -2114,6 +2183,7 @@ class InferenceEngine:
             return
         seq.generated.append(nxt)
         seq.last_token = nxt
+        self.tokens_generated_total += 1
         handle = seq.handle
         if len(seq.generated) == 1:
             handle.marks.setdefault("first_token", time.perf_counter())
@@ -2281,6 +2351,32 @@ class InferenceEngine:
                 # by LRU/pressure), so no invalidate.
                 self._drop_conversation_locked(cid, invalidate=False)
 
+    def _hbm_snapshot(self) -> Dict:
+        """HBM accounting for the device-telemetry plane: pool
+        occupancy/fragmentation + prefix/pin footprints from the host
+        allocator, per-chip byte totals from the executor when it has a
+        device (JaxExecutor.hbm_info). Called from the /metrics scrape
+        and stats routes — never the step path."""
+        alloc = self.allocator
+        used, total = alloc.used(), alloc.total
+        out: Dict = {
+            "kv_pages_used": used,
+            "kv_pages_total": total,
+            "kv_pool_occupancy": round(used / total, 4) if total else 0.0,
+            "kv_pool_fragmentation": alloc.fragmentation(),
+            "pinned_pages": alloc.pinned_pages(),
+            "prefix_cache_pages": (self._prefix_cache.pages
+                                   if self._prefix_cache is not None
+                                   else 0),
+        }
+        info_fn = getattr(self.executor, "hbm_info", None)
+        if info_fn is not None:
+            try:
+                out["chips"] = info_fn()
+            except Exception:  # noqa: BLE001 — accounting, not a gate
+                log.exception("hbm_info failed for %s", self.name)
+        return out
+
     def _set_gauges(self) -> None:
         if not self._metrics:
             return
@@ -2312,6 +2408,7 @@ class InferenceEngine:
             "active": sum(1 for s in self._slots if s is not None),
             "pending": pending,
             "decode_steps": self.steps,
+            "tokens_generated": self.tokens_generated_total,
             "kv_pages_used": self.allocator.used(),
             "kv_pages_total": self.allocator.total,
             "cached_conversations": cached,
@@ -2323,6 +2420,10 @@ class InferenceEngine:
             "prefill_tps_ewma": (round(self.prefill_tps_ewma, 1)
                                  if self.prefill_tps_ewma else None),
             "profile": self._prof.summary(),
+            # Device telemetry plane (docs/observability.md "Device
+            # telemetry"): step decomposition, live tok/s + MFU, HBM,
+            # compile-cache state.
+            "device": self._telemetry.snapshot(),
         }
         if self._mixed_cfg is not None:
             out["mixed_batch"] = {

@@ -29,6 +29,7 @@ tokens, which bounds realtime admission latency to K decode steps.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Protocol
 
@@ -287,7 +288,8 @@ class JaxExecutor:
                  chunk_size: int = 16, prefill_batch: int = 4,
                  mixed_prefill_slices: int = 2,
                  mixed_slice_tokens: int = 64,
-                 mesh=None) -> None:
+                 mesh=None, telemetry_name: str = "engine0",
+                 telemetry_metrics: Optional[bool] = None) -> None:
         import jax
         import jax.numpy as jnp
         from functools import partial
@@ -580,6 +582,113 @@ class JaxExecutor:
         #: tier-aware admission cap converts its latency target into a
         #: step budget with this.
         self.step_ms: Optional[float] = None
+        #: Device telemetry (observability/device.py): compile-cache
+        #: hit/miss + per-program compile seconds land here during
+        #: warmup; the engine built on top of this executor shares the
+        #: same instance by name (builder passes its engine name).
+        #: ``telemetry_metrics`` matters because warmup runs BEFORE the
+        #: engine exists to set the flag — a metrics-off bench/engine
+        #: must not have its warmup write prometheus families.
+        from llmq_tpu.observability.device import get_device_telemetry
+        self._telemetry = get_device_telemetry(telemetry_name,
+                                               metrics=telemetry_metrics)
+        self._telemetry.configure_model(**self.telemetry_info())
+        #: (device id → static weights/KV byte totals) — computed
+        #: lazily on the first hbm_info() call; the donated cache
+        #: rebinds every step but its shapes (= bytes) never change.
+        self._hbm_static: Optional[Dict[int, Dict[str, int]]] = None
+        self._warm_mu = threading.Lock()
+        self._warm_done = 0
+
+    def telemetry_info(self) -> Dict:
+        """Model identity for the MFU estimator — shared with the
+        engine's telemetry registration (same math bench.py uses)."""
+        import jax
+
+        from llmq_tpu.models.llama import param_count
+        try:
+            from llmq_tpu.ops.quant import is_quantized
+            quant = ("int8"
+                     if is_quantized(self.params["layers"]["wq"]) else "")
+        except Exception:  # noqa: BLE001 — non-llama param trees
+            quant = ""
+        try:
+            n_params = param_count(self.params)
+        except Exception:  # noqa: BLE001
+            n_params = 0
+        return {"n_params": n_params,
+                "device_kind": jax.devices()[0].device_kind,
+                "quant": quant}
+
+    def hbm_info(self) -> List[Dict]:
+        """Per-chip HBM accounting: weights / KV-pool bytes resident on
+        each local device (sharded trees split per device via sharding
+        METADATA), plus free/limit from the runtime's ``memory_stats``
+        where the backend provides it (TPU yes, CPU no).
+
+        Metadata-only by design: this runs on the scrape thread while
+        the engine thread donates ``self.cache`` every step — touching
+        shard BUFFERS (``.data.nbytes``) would race their deletion
+        ("Array has been deleted"); shape/dtype/sharding survive
+        donation."""
+        import math
+
+        jax = self._jax
+        if self._hbm_static is None:
+            per: Dict[int, Dict[str, int]] = {}
+
+            def add(tree, key: str) -> None:
+                for leaf in jax.tree.leaves(tree):
+                    shape = getattr(leaf, "shape", None)
+                    dtype = getattr(leaf, "dtype", None)
+                    if shape is None or dtype is None:
+                        continue
+                    itemsize = np.dtype(dtype).itemsize
+                    sharding = getattr(leaf, "sharding", None)
+                    devs = list(getattr(sharding, "addressable_devices",
+                                        None) or [])
+                    if devs:
+                        try:
+                            shard_bytes = (
+                                math.prod(sharding.shard_shape(shape))
+                                * itemsize)
+                        except Exception:  # noqa: BLE001 — fallback split
+                            shard_bytes = (math.prod(shape) * itemsize
+                                           // len(devs))
+                        for dv in devs:
+                            d = per.setdefault(
+                                dv.id,
+                                {"weights_bytes": 0, "kv_pool_bytes": 0})
+                            d[key] += int(shard_bytes)
+                    else:
+                        d = per.setdefault(
+                            0, {"weights_bytes": 0, "kv_pool_bytes": 0})
+                        d[key] += int(math.prod(shape) * itemsize)
+
+            add(self.params, "weights_bytes")
+            add(self.cache, "kv_pool_bytes")
+            self._hbm_static = per
+        chips = []
+        for dev in jax.local_devices():
+            d = self._hbm_static.get(dev.id)
+            if d is None:
+                continue   # chip holds no model state (unsharded run)
+            entry = {"chip": str(dev.id), "kind": dev.device_kind,
+                     "weights_bytes": d.get("weights_bytes", 0),
+                     "kv_pool_bytes": d.get("kv_pool_bytes", 0),
+                     "free_bytes": None, "limit_bytes": None}
+            try:
+                stats = dev.memory_stats() or {}
+                limit = stats.get("bytes_limit")
+                in_use = stats.get("bytes_in_use")
+                if limit is not None:
+                    entry["limit_bytes"] = int(limit)
+                    if in_use is not None:
+                        entry["free_bytes"] = int(limit) - int(in_use)
+            except Exception:  # noqa: BLE001 — CPU backends lack stats
+                pass
+            chips.append(entry)
+        return chips
 
     # -- helpers -------------------------------------------------------------
 
@@ -749,8 +858,21 @@ class JaxExecutor:
         if exp_dir:
             os.makedirs(exp_dir, exist_ok=True)
 
+        def note(name: str, t0: float, cache_hit: bool) -> None:
+            # Compile-cache observability (docs/observability.md
+            # "Device telemetry"): per-program compile seconds +
+            # hit/miss counters + the warmup-progress gauge, so the
+            # geometry grid's compile cost is attributable per program.
+            self._telemetry.note_compile(name, time.perf_counter() - t0,
+                                         cache_hit)
+            with self._warm_mu:
+                self._warm_done += 1
+                done = self._warm_done
+            self._telemetry.note_warmup(done, len(jobs))
+
         def compile_one(job):
             name, fn, args = job
+            t0 = time.perf_counter()
             path = (os.path.join(exp_dir, f"{exp_key}-{name}.jaxexp")
                     if exp_dir else None)
             if path and os.path.exists(path):
@@ -765,6 +887,7 @@ class JaxExecutor:
                         exported.call,
                         donate_argnums=(1,)).lower(*args).compile()
                     self._from_export_cache.add(name)
+                    note(name, t0, cache_hit=True)
                     return f"{name} (export cache)"
                 except Exception:  # noqa: BLE001 — cache is best-effort
                     log.exception(
@@ -784,13 +907,18 @@ class JaxExecutor:
                     with open(tmp, "wb") as f:
                         f.write(exported.serialize())
                     os.replace(tmp, path)
+                    note(name, t0, cache_hit=False)
                     return f"{name} (exported)"
                 except Exception:  # noqa: BLE001
                     log.exception(
                         "export of %s failed; plain AOT compile", name)
             self._aot[name] = fn.lower(*args).compile()
+            note(name, t0, cache_hit=False)
             return name
 
+        with self._warm_mu:
+            self._warm_done = 0
+        self._telemetry.note_warmup(0, len(jobs))
         with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
             for name in pool.map(compile_one, jobs):
                 log.info("warmup compiled %s", name)
@@ -808,6 +936,7 @@ class JaxExecutor:
         executions are what keeps a warm restart from hitting its <60 s
         target (a 2048-token prefill execution over a tunneled runtime
         costs many seconds by itself)."""
+        t_warm0 = time.perf_counter()
         try:
             self._warmup_parallel()
         except Exception:  # noqa: BLE001 — AOT is an optimization; the
@@ -887,6 +1016,16 @@ class JaxExecutor:
                 self.step_ms = None
                 log.warning("decode step timing unusable (EOS latched "
                             "every chunk); admission cap falls back")
+        self._telemetry.note_warmup_complete(
+            time.perf_counter() - t_warm0)
+        try:
+            # The serving-path RTT floor (previously bench-only): live
+            # on /metrics so tail-latency numbers are interpretable
+            # without re-running the bench.
+            from llmq_tpu.observability.device import measure_rtt
+            self._telemetry.set_rtt(measure_rtt())
+        except Exception:  # noqa: BLE001 — telemetry only
+            log.exception("rtt measurement failed")
 
     # -- Executor API --------------------------------------------------------
 

@@ -129,6 +129,28 @@ class PageAllocator:
         with self._mu:
             return sum(1 for r in self._refs.values() if r > 1)
 
+    def fragmentation(self) -> float:
+        """External fragmentation of the free page-id space:
+        1 − (largest contiguous free run / free pages). 0 when the
+        free list is empty or one contiguous run. Paged attention
+        doesn't need contiguity, so this is purely an observability
+        signal — it tracks how interleaved the live working set has
+        become (device telemetry plane, docs/observability.md). The
+        O(n log n) sort runs OUTSIDE the lock (this is called from
+        every /metrics scrape; the decode path's alloc/free must not
+        stall behind it)."""
+        with self._mu:
+            free = list(self._free)
+        free.sort()
+        if not free:
+            return 0.0
+        best = run = 1
+        for a, b in zip(free, free[1:]):
+            run = run + 1 if b == a + 1 else 1
+            if run > best:
+                best = run
+        return round(1.0 - best / len(free), 4)
+
     def pinned_pages(self) -> int:
         with self._mu:
             return sum(len(p) for p in self._pins.values())

@@ -154,6 +154,21 @@ class HttpEngineClient:
     def healthy(self) -> bool:
         return self.probe() == "ok"
 
+    def engine_stats(self, timeout: Optional[float] = None) -> dict:
+        """Fetch the peer's ``GET /api/v1/engine/stats`` — its engine
+        counters plus the device-telemetry block (MFU, HBM, step
+        decomposition) the cluster overview rolls up. Probe-grade
+        timeout by default: a rollup must not hang the admin route on
+        one slow replica. Raises on any transport/HTTP failure — the
+        caller (ClusterRouter.overview) degrades per replica."""
+        with urllib.request.urlopen(
+                f"{self.base_url}/api/v1/engine/stats",
+                timeout=timeout or self.probe_timeout) as resp:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"engine stats HTTP {resp.status} from {self.name}")
+            return json.loads(resp.read().decode("utf-8"))
+
     def process_fn(self, ctx, msg: Message) -> None:
         """Worker seam: relay one drained message to the peer and fold
         the completion back into ``msg`` (same contract as

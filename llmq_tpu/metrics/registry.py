@@ -43,6 +43,47 @@ _PROC_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
 #: live well under a second; finer low end than the queue buckets.
 _STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                   0.25, 0.5, 1, 2.5, 5, 10, 30)
+#: Per-chunk step-time components in MILLISECONDS: sub-0.1 ms host
+#: dispatches on echo, up to seconds through a tunneled runtime.
+_STEP_MS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+                    25, 50, 100, 250, 500, 1000, 2500)
+#: Program compiles: sub-second export-cache loads up to multi-minute
+#: cold Mosaic lowerings (303 s observed in BENCH_r03).
+_COMPILE_BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+#: Metrics-cardinality contract (tests/test_metrics_cardinality.py):
+#: EVERY label any family in this registry uses must appear here.
+#: A frozenset value is a closed enum the observed label values must
+#: stay within; ``None`` marks labels bounded by configuration or
+#: hardware (engine names, endpoint ids, chip indices, program names,
+#: queue/manager names, rolling-window labels) — those may not carry
+#: per-request values (request ids, UUIDs), which the guard test
+#: rejects by pattern. Adding a label without extending this table
+#: fails the guard on purpose: unbounded label sets are how Prometheus
+#: instances die.
+LABEL_CONTRACT = {
+    "manager": None,
+    "queue": None,
+    "engine": None,
+    "endpoint": None,
+    "chip": None,
+    "program": None,
+    "window": None,     # "5m"/"1h"-style, validated by pattern
+    "priority": frozenset({"realtime", "high", "normal", "low",
+                           "unknown"}),
+    "operation": frozenset({"push", "pop", "batch_pop", "complete",
+                            "fail", "requeue", "retry_stash", "remove"}),
+    "status": frozenset({"success", "error", "healthy", "degraded",
+                         "unhealthy", "draining"}),
+    "reason": frozenset({"affinity", "spill", "select", "failover",
+                         "backlog", "sla", "engine_down"}),
+    "path": frozenset({"mixed", "program"}),
+    "point": None,      # compiled-in chaos fault points (fnmatch keys)
+    "kind": frozenset({"error", "timeout", "partial", "oserror",
+                       "latency", "crash"}),
+    "code": frozenset({"429", "503", "500"}),
+    "slo": frozenset({"ttft", "realtime"}),
+}
 
 
 class QueueMetrics:
@@ -240,6 +281,90 @@ class QueueMetrics:
             f"{ns}_engine_recovered_requests_total",
             "In-flight requests failed over to the retry path by an "
             "engine crash recovery", ["engine"], registry=registry)
+        # Device telemetry plane (llmq_tpu/observability/device.py,
+        # docs/observability.md "Device telemetry"): per-chunk step
+        # decomposition, live decode rate + MFU, HBM accounting,
+        # compile/export-cache visibility, SLO burn rates.
+        self.step_dispatch_ms = Histogram(
+            f"{ns}_step_dispatch_ms",
+            "Host-side batch assembly + program dispatch per decode/"
+            "mixed chunk (ms)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.step_device_ms = Histogram(
+            f"{ns}_step_device_ms",
+            "Device execution per chunk: dispatch until the output "
+            "array is ready (ms)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.step_readback_ms = Histogram(
+            f"{ns}_step_readback_ms",
+            "Token readback per chunk: device→host transfer of the "
+            "sampled token matrix (ms)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.decode_tokens_per_s = Gauge(
+            f"{ns}_decode_tokens_per_s",
+            "Decode tokens/s over the telemetry trailing window",
+            ["engine"], registry=registry)
+        self.mfu_pct = Gauge(
+            f"{ns}_mfu_pct",
+            "Live decode MFU estimate (percent of device peak FLOPs; "
+            "0 for the echo backend)", ["engine"], registry=registry)
+        self.host_device_rtt_ms = Gauge(
+            f"{ns}_host_device_rtt_ms",
+            "Measured host<->device round-trip floor (ms)", ["engine"],
+            registry=registry)
+        self.hbm_weights_bytes = Gauge(
+            f"{ns}_hbm_weights_bytes",
+            "Model weight bytes resident per chip", ["engine", "chip"],
+            registry=registry)
+        self.hbm_kv_pool_bytes = Gauge(
+            f"{ns}_hbm_kv_pool_bytes",
+            "Paged-KV pool bytes resident per chip", ["engine", "chip"],
+            registry=registry)
+        self.hbm_free_bytes = Gauge(
+            f"{ns}_hbm_free_bytes",
+            "Free HBM per chip (runtime memory_stats; absent on "
+            "backends without it)", ["engine", "chip"],
+            registry=registry)
+        self.hbm_limit_bytes = Gauge(
+            f"{ns}_hbm_limit_bytes",
+            "Total HBM per chip (runtime memory_stats)",
+            ["engine", "chip"], registry=registry)
+        self.kv_pool_occupancy = Gauge(
+            f"{ns}_kv_pool_occupancy",
+            "Fraction of allocatable KV pages in use", ["engine"],
+            registry=registry)
+        self.kv_pool_fragmentation = Gauge(
+            f"{ns}_kv_pool_fragmentation",
+            "External fragmentation of the free page-id space "
+            "(1 - largest contiguous free run / free pages)",
+            ["engine"], registry=registry)
+        self.compile_cache_hits = Counter(
+            f"{ns}_compile_cache_hits_total",
+            "Warmup programs served from the export disk cache",
+            ["engine"], registry=registry)
+        self.compile_cache_misses = Counter(
+            f"{ns}_compile_cache_misses_total",
+            "Warmup programs that had to trace+lower+compile",
+            ["engine"], registry=registry)
+        self.compile_seconds = Histogram(
+            f"{ns}_compile_seconds",
+            "Per-program warmup compile (or export-cache load) time",
+            ["engine", "program"], buckets=_COMPILE_BUCKETS,
+            registry=registry)
+        self.warmup_progress = Gauge(
+            f"{ns}_warmup_progress",
+            "Warmup completion fraction (0..1) — programs compiled / "
+            "programs planned", ["engine"], registry=registry)
+        # SLO layer (llmq_tpu/observability/slo.py): burn rate 1.0 =
+        # spending exactly the allowed error budget over the window.
+        self.slo_burn_rate = Gauge(
+            f"{ns}_slo_burn_rate",
+            "Error-budget burn rate per SLO and rolling window",
+            ["slo", "window"], registry=registry)
+        self.slo_error_budget_remaining = Gauge(
+            f"{ns}_slo_error_budget_remaining",
+            "Remaining error-budget fraction over the longest window "
+            "(0 = exhausted)", ["slo"], registry=registry)
 
 
 def get_metrics() -> QueueMetrics:
@@ -256,8 +381,22 @@ def exposition() -> bytes:
     try:
         # Stage-histogram observations are deferred off the request hot
         # path; the scrape is where they land (docs/observability.md).
+        # This also FEEDS the SLO tracker, so it must run before the
+        # SLO flush below.
         from llmq_tpu.observability.recorder import get_recorder
         get_recorder().flush_metrics()
     except Exception:  # noqa: BLE001 — scrape must not fail on trace plane
+        pass
+    try:
+        # Device gauges (tok/s, MFU, HBM) refresh at scrape time too —
+        # same hot-path discipline as the stage histograms.
+        from llmq_tpu.observability.device import flush_all
+        flush_all()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from llmq_tpu.observability.slo import get_slo_tracker
+        get_slo_tracker().flush()
+    except Exception:  # noqa: BLE001
         pass
     return generate_latest(REGISTRY)

@@ -10,7 +10,12 @@ package makes one request legible across all of them:
   stage timelines (ring + SLA-breach retention), feeding the
   Prometheus stage histograms on each request's terminal event;
 - :mod:`chrome` — Chrome/Perfetto trace export stitching host
-  timelines with executor ``SpanRecorder`` spans.
+  timelines with executor ``SpanRecorder`` spans;
+- :mod:`device` — the device telemetry plane: step-time decomposition,
+  live MFU/decode-rate, HBM accounting, compile-cache visibility,
+  single-flight on-demand profiling;
+- :mod:`slo` — config-defined SLO targets and rolling error-budget
+  burn rates, fed from the recorder's finalized timelines.
 
 The usage contract for instrumented layers is one line:
 
@@ -21,6 +26,19 @@ which no-ops fast when ``observability.enabled`` is false.
 """
 
 from llmq_tpu.observability.chrome import chrome_trace, perf_anchor  # noqa: F401
+from llmq_tpu.observability.device import (  # noqa: F401
+    DeviceTelemetry,
+    ProfileInProgress,
+    decode_mfu,
+    get_device_telemetry,
+    measure_rtt,
+    peak_flops,
+)
+from llmq_tpu.observability.slo import (  # noqa: F401
+    SloTracker,
+    configure_slo,
+    get_slo_tracker,
+)
 from llmq_tpu.observability.recorder import (  # noqa: F401
     TERMINAL_STAGES,
     FlightRecorder,

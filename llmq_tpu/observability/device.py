@@ -1,0 +1,527 @@
+"""Device telemetry plane (docs/observability.md "Device telemetry").
+
+PR 3 made *requests* legible (stage timelines, flight recorder); this
+module makes the *device* legible while serving — the numbers that were
+previously computed only offline in bench.py and therefore invisible in
+production:
+
+- **Step-time decomposition** — every decode/mixed chunk is split into
+  host dispatch (batch assembly + program dispatch), device execute
+  (dispatch → output ready) and token readback (device→host transfer),
+  exported as ``step_{dispatch,device,readback}_ms`` histograms. This
+  is the measurement the APEX-style async-pipeline work (ROADMAP item
+  4) will be judged against: you cannot erase an RTT you never see.
+- **Live MFU / decode tok/s** — the FLOPs math bench.py used offline
+  (``mfu_pct``) lives here now; bench and the serving path share one
+  implementation, and a gauge tracks the trailing-window decode rate.
+- **HBM accounting** — per-chip weights/KV-pool footprints, pool
+  occupancy/fragmentation, free headroom (``jax`` ``memory_stats``
+  where the backend provides it).
+- **Compile/export-cache visibility** — per-program compile seconds,
+  export-cache hit/miss counters and a warmup-progress gauge, so the
+  303 s compile surface of BENCH_r03 is attributable per program.
+- **On-demand profiling** — a single-flight ``jax.profiler`` capture
+  behind ``POST /api/v1/admin/profile`` (concurrent captures 409).
+
+One :class:`DeviceTelemetry` per engine name (process-singleton map,
+like ``metrics.get_metrics``): the engine, its executor, the bench and
+the API server all read/write the same live registry. Hot-path writes
+(``note_step``) are a few dict/deque updates plus three histogram
+observes — the <3 % step-path budget is guarded by
+tests/test_device_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("observability.device")
+
+# -- shared FLOPs / RTT math (moved out of bench.py; bench imports these) -----
+
+#: device_kind substring → peak bf16 TFLOP/s (the bench's table,
+#: now the single copy both bench and serving consult).
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v4": 275e12, "v6": 918e12,
+}
+
+_DEFAULT_PEAK = 197e12
+
+
+def peak_flops(device_kind: str, quant: str = "") -> float:
+    """Peak FLOP/s for a device kind; int8 weights double the v5e MXU
+    path's rate (same convention bench.py used)."""
+    kl = (device_kind or "").lower()
+    peak = _DEFAULT_PEAK
+    for k, v in PEAK_BF16_FLOPS.items():
+        if k in kl:
+            peak = v
+            break
+    if quant == "int8":
+        peak *= 2
+    return peak
+
+
+def decode_mfu(tokens_per_s: float, n_params: int, device_kind: str,
+               quant: str = "") -> float:
+    """Decode-phase model FLOPs utilization as a FRACTION: each token
+    costs ~2·n_params FLOPs (the dense matmuls; attention is negligible
+    at serving context lengths)."""
+    if tokens_per_s <= 0 or n_params <= 0:
+        return 0.0
+    return tokens_per_s * 2.0 * n_params / peak_flops(device_kind, quant)
+
+
+def measure_rtt(samples: int = 5) -> float:
+    """Host↔device round-trip floor in ms (median of ``samples`` tiny
+    synchronous dispatch+fetch cycles): every synchronous fetch pays
+    this (≈0.1-0.2 ms on a TPU VM; ~70-110 ms through a tunneled dev
+    runtime). Shared by bench.py and executor warmup."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    np.asarray(f(x))    # compile outside the timed loop
+    rtts = []
+    for _ in range(max(1, samples)):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        rtts.append(time.perf_counter() - t0)
+    return sorted(rtts)[len(rtts) // 2] * 1e3
+
+
+# -- per-engine telemetry ------------------------------------------------------
+
+
+class _StepStat:
+    """Running count/sum/max/last for one step component (ms)."""
+
+    __slots__ = ("count", "total_ms", "max_ms", "last_ms")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self.last_ms = 0.0
+
+    def add(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        self.last_ms = ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "total_ms": round(self.total_ms, 3),
+            "mean_ms": round(self.total_ms / self.count, 3)
+            if self.count else 0.0,
+            "max_ms": round(self.max_ms, 3),
+            "last_ms": round(self.last_ms, 3),
+        }
+
+
+class DeviceTelemetry:
+    """Live device-plane state for one engine name.
+
+    Writers: the engine's scheduling thread (``note_step``), the
+    executor's warmup threads (``note_compile``/``note_warmup``).
+    Readers: the /metrics scrape (``flush``), ``get_stats`` snapshots,
+    and bench's per-rate-point attribution. A small lock guards the
+    cross-thread aggregates; the prometheus client is internally
+    thread-safe."""
+
+    #: Trailing window for the live decode-rate gauge.
+    RATE_WINDOW_S = 30.0
+
+    def __init__(self, name: str, *, metrics: bool = True) -> None:
+        self.name = name
+        #: When False, ``note_step`` skips the prometheus observes but
+        #: keeps the host-side aggregates (bench engines run with
+        #: metrics off yet still read per-rate-point telemetry).
+        self.metrics_enabled = metrics
+        self._mu = threading.Lock()
+        self._dispatch = _StepStat()
+        self._device = _StepStat()
+        self._readback = _StepStat()
+        self._tokens_total = 0
+        self._tok_window: deque = deque()   # (ts, n_tokens)
+        # Model identity for the MFU estimator (executor fills these).
+        self.n_params = 0
+        self.device_kind = ""
+        self.quant = ""
+        self.rtt_ms: Optional[float] = None
+        # Compile/export-cache surface (executor warmup fills these).
+        self._compile: Dict[str, Dict[str, Any]] = {}
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._warmup_done = 0
+        self._warmup_total = 0
+        self.warmup_s: Optional[float] = None
+        #: Callback returning the HBM snapshot dict (engine registers
+        #: it; see InferenceEngine._hbm_snapshot).
+        self._hbm_provider: Optional[Callable[[], Dict]] = None
+        #: Cached labeled histogram children: ``.labels()`` revalidates
+        #: on every call (~3 µs × 3 families) — cached, observing the
+        #: whole backlog at scrape time stays cheap.
+        self._step_hists: Optional[tuple] = None
+        #: Step observations awaiting histogram observe — drained by
+        #: ``flush`` at scrape time, the same deferred-observation
+        #: design as the recorder's stage histograms: prometheus costs
+        #: stay off the decode hot path entirely (the <3 % budget).
+        #: Bounded; under scrape outage the newest observations win.
+        self._pending_steps: deque = deque(maxlen=8192)
+
+    # -- wiring ---------------------------------------------------------------
+
+    def configure_model(self, *, n_params: int = 0, device_kind: str = "",
+                        quant: str = "") -> None:
+        self.n_params = int(n_params)
+        self.device_kind = device_kind
+        self.quant = quant
+
+    def set_hbm_provider(self, fn: Optional[Callable[[], Dict]]) -> None:
+        self._hbm_provider = fn
+
+    def set_rtt(self, rtt_ms: float) -> None:
+        self.rtt_ms = float(rtt_ms)
+        if self.metrics_enabled:
+            self._metrics().host_device_rtt_ms.labels(self.name).set(
+                self.rtt_ms)
+
+    @staticmethod
+    def _metrics():
+        from llmq_tpu.metrics.registry import get_metrics
+        return get_metrics()
+
+    # -- step decomposition (hot path) ----------------------------------------
+
+    def note_step(self, dispatch_s: float, device_s: float,
+                  readback_s: float, tokens: int) -> None:
+        """One decode/mixed chunk's timing split. Called once per chunk
+        from the engine thread — budgeted at <3 % of the echo step path
+        (guarded in tests)."""
+        d_ms = dispatch_s * 1e3
+        x_ms = device_s * 1e3
+        r_ms = readback_s * 1e3
+        now = time.time()
+        with self._mu:
+            self._dispatch.add(d_ms)
+            self._device.add(x_ms)
+            self._readback.add(r_ms)
+            if tokens > 0:
+                self._tokens_total += tokens
+                self._tok_window.append((now, tokens))
+            # Prune opportunistically so the deque stays bounded even
+            # if nothing ever flushes.
+            horizon = now - self.RATE_WINDOW_S
+            while self._tok_window and self._tok_window[0][0] < horizon:
+                self._tok_window.popleft()
+        if self.metrics_enabled:
+            self._pending_steps.append((d_ms, x_ms, r_ms))
+
+    def timed_fetch(self, handle):
+        """Fetch a chunk handle's tokens with the device-execute /
+        readback split: ``block_until_ready`` on the output array
+        bounds device execution, the ``fetch()`` that follows is the
+        host transfer (``np.asarray`` is the real completion fence on
+        tunneled runtimes, so readback absorbs any under-wait).
+        Returns ``(result, device_s, readback_s)``."""
+        t0 = time.perf_counter()
+        out = getattr(handle, "out", None)
+        if out is not None:
+            ready = getattr(out, "block_until_ready", None)
+            if ready is not None:
+                try:
+                    ready()
+                except Exception:  # noqa: BLE001 — split is best-effort
+                    pass
+        t1 = time.perf_counter()
+        res = handle.fetch()
+        t2 = time.perf_counter()
+        return res, t1 - t0, t2 - t1
+
+    # -- decode rate / MFU ----------------------------------------------------
+
+    def tokens_per_s(self) -> float:
+        """Decode rate over the trailing window (0 when idle)."""
+        now = time.time()
+        horizon = now - self.RATE_WINDOW_S
+        with self._mu:
+            while self._tok_window and self._tok_window[0][0] < horizon:
+                self._tok_window.popleft()
+            if not self._tok_window:
+                return 0.0
+            total = sum(n for _, n in self._tok_window)
+            span = now - self._tok_window[0][0]
+        if span < 0.05:
+            span = 0.05   # burst floor: avoid a div-by-~0 rate spike
+        return total / span
+
+    def mfu(self) -> float:
+        return decode_mfu(self.tokens_per_s(), self.n_params,
+                          self.device_kind, self.quant)
+
+    # -- compile / warmup -----------------------------------------------------
+
+    def note_compile(self, program: str, seconds: float,
+                     cache_hit: bool) -> None:
+        """One program's warmup compile (or export-cache load).
+        ``program`` is a compiled-program name (decode, decode_chunk,
+        mixed_chunk, prefill_b<N>…) — a config-bounded label set."""
+        with self._mu:
+            self._compile[program] = {
+                "seconds": round(seconds, 3),
+                "source": "export_cache" if cache_hit else "compiled",
+            }
+            if cache_hit:
+                self._cache_hits += 1
+            else:
+                self._cache_misses += 1
+        if self.metrics_enabled:
+            m = self._metrics()
+            if cache_hit:
+                m.compile_cache_hits.labels(self.name).inc()
+            else:
+                m.compile_cache_misses.labels(self.name).inc()
+            m.compile_seconds.labels(self.name, program).observe(seconds)
+
+    def note_warmup(self, done: int, total: int) -> None:
+        with self._mu:
+            self._warmup_done = done
+            self._warmup_total = total
+        if self.metrics_enabled and total > 0:
+            self._metrics().warmup_progress.labels(self.name).set(
+                done / total)
+
+    def note_warmup_complete(self, seconds: float) -> None:
+        self.warmup_s = round(seconds, 2)
+        with self._mu:
+            if self._warmup_total == 0:
+                self._warmup_total = self._warmup_done = 1
+            else:
+                self._warmup_done = self._warmup_total
+        if self.metrics_enabled:
+            self._metrics().warmup_progress.labels(self.name).set(1.0)
+
+    # -- scrape-time flush / snapshot -----------------------------------------
+
+    def flush(self) -> None:
+        """Drain the pending step observations into the histograms and
+        set the live gauges (rate, MFU, HBM) — called from the /metrics
+        scrape path, keeping all prometheus costs off the decode hot
+        path (same design as recorder.flush_metrics)."""
+        if not self.metrics_enabled:
+            return
+        m = self._metrics()
+        hists = self._step_hists
+        if hists is None:
+            hists = (m.step_dispatch_ms.labels(self.name),
+                     m.step_device_ms.labels(self.name),
+                     m.step_readback_ms.labels(self.name))
+            self._step_hists = hists
+        while True:
+            try:
+                d_ms, x_ms, r_ms = self._pending_steps.popleft()
+            except IndexError:
+                break
+            hists[0].observe(d_ms)
+            hists[1].observe(x_ms)
+            hists[2].observe(r_ms)
+        rate = self.tokens_per_s()
+        m.decode_tokens_per_s.labels(self.name).set(rate)
+        m.mfu_pct.labels(self.name).set(
+            decode_mfu(rate, self.n_params, self.device_kind,
+                       self.quant) * 100.0)
+        hbm = self._hbm()
+        if hbm is None:
+            return
+        m.kv_pool_occupancy.labels(self.name).set(
+            hbm.get("kv_pool_occupancy", 0.0))
+        m.kv_pool_fragmentation.labels(self.name).set(
+            hbm.get("kv_pool_fragmentation", 0.0))
+        for chip in hbm.get("chips", ()):
+            cid = str(chip.get("chip", "0"))
+            m.hbm_weights_bytes.labels(self.name, cid).set(
+                chip.get("weights_bytes", 0))
+            m.hbm_kv_pool_bytes.labels(self.name, cid).set(
+                chip.get("kv_pool_bytes", 0))
+            if chip.get("free_bytes") is not None:
+                m.hbm_free_bytes.labels(self.name, cid).set(
+                    chip["free_bytes"])
+            if chip.get("limit_bytes") is not None:
+                m.hbm_limit_bytes.labels(self.name, cid).set(
+                    chip["limit_bytes"])
+
+    def _hbm(self) -> Optional[Dict]:
+        if self._hbm_provider is None:
+            return None
+        try:
+            return self._hbm_provider()
+        except Exception:  # noqa: BLE001 — telemetry must not fail scrapes
+            log.exception("hbm provider failed for %s", self.name)
+            return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``device`` block of ``GET /api/v1/engine/stats`` — and
+        what bench attaches per rate point."""
+        rate = self.tokens_per_s()
+        with self._mu:
+            out: Dict[str, Any] = {
+                "steps": {
+                    "count": self._dispatch.count,
+                    "dispatch_ms": self._dispatch.to_dict(),
+                    "device_ms": self._device.to_dict(),
+                    "readback_ms": self._readback.to_dict(),
+                },
+                "tokens_total": self._tokens_total,
+                "decode_tokens_per_s": round(rate, 1),
+                "mfu_pct": round(
+                    decode_mfu(rate, self.n_params, self.device_kind,
+                               self.quant) * 100.0, 3),
+                "model": {
+                    "n_params": self.n_params,
+                    "device_kind": self.device_kind,
+                    "quant": self.quant or "bf16",
+                },
+                "host_device_rtt_ms": (round(self.rtt_ms, 2)
+                                       if self.rtt_ms is not None
+                                       else None),
+                "compile": {
+                    "programs": dict(self._compile),
+                    "cache_hits": self._cache_hits,
+                    "cache_misses": self._cache_misses,
+                    "warmup_done": self._warmup_done,
+                    "warmup_total": self._warmup_total,
+                    "warmup_s": self.warmup_s,
+                },
+            }
+        hbm = self._hbm()
+        if hbm is not None:
+            out["hbm"] = hbm
+        return out
+
+
+# -- process registry ----------------------------------------------------------
+
+_TELEMETRY_LOCK = threading.Lock()
+_TELEMETRY: Dict[str, DeviceTelemetry] = {}
+
+
+def get_device_telemetry(name: str = "engine0",
+                         metrics: Optional[bool] = None) -> DeviceTelemetry:
+    """Per-engine-name singleton (the engine, its executor and the
+    bench all address the same instance). ``metrics`` updates the
+    prometheus on/off flag when given."""
+    with _TELEMETRY_LOCK:
+        t = _TELEMETRY.get(name)
+        if t is None:
+            t = DeviceTelemetry(name, metrics=metrics
+                                if metrics is not None else True)
+            _TELEMETRY[name] = t
+        elif metrics is not None:
+            t.metrics_enabled = metrics
+        return t
+
+
+def flush_all() -> None:
+    """Refresh every engine's live gauges — called from the /metrics
+    exposition path."""
+    with _TELEMETRY_LOCK:
+        ts = list(_TELEMETRY.values())
+    for t in ts:
+        t.flush()
+
+
+def reset_telemetry() -> None:
+    """Drop all instances (tests only — prometheus families persist)."""
+    with _TELEMETRY_LOCK:
+        _TELEMETRY.clear()
+
+
+# -- on-demand profiling (single-flight) ---------------------------------------
+
+
+class ProfileInProgress(RuntimeError):
+    """A jax.profiler capture is already running — concurrent captures
+    would corrupt each other's sessions (the profiler is a process-wide
+    singleton), so the API answers 409."""
+
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_ACTIVE: Optional[Dict[str, Any]] = None
+_PROFILE_LAST: Optional[Dict[str, Any]] = None
+
+MAX_PROFILE_S = 60.0
+
+
+def start_profile(*, duration_s: float = 1.0, label: str = "ondemand",
+                  base_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Kick off a BOUNDED background ``jax.profiler`` capture through
+    :func:`utils.profiling.trace` and return its descriptor
+    immediately. Raises :class:`ProfileInProgress` when a capture is
+    already live (the endpoint's 409). The capture is clamped to
+    ``MAX_PROFILE_S`` — an unbounded trace would fill the disk on a
+    busy replica."""
+    global _PROFILE_ACTIVE
+    duration_s = min(max(float(duration_s), 0.01), MAX_PROFILE_S)
+    with _PROFILE_LOCK:
+        if _PROFILE_ACTIVE is not None:
+            raise ProfileInProgress(
+                f"profile capture already running "
+                f"(started {_PROFILE_ACTIVE['started']:.0f}, "
+                f"path {_PROFILE_ACTIVE['path']})")
+        out_dir = base_dir or tempfile.mkdtemp(prefix="llmq-profile-")
+        info = {
+            "label": label,
+            "path": os.path.join(out_dir, label),
+            "duration_s": duration_s,
+            "started": time.time(),
+        }
+        _PROFILE_ACTIVE = info
+
+    def run() -> None:
+        global _PROFILE_ACTIVE, _PROFILE_LAST
+        from llmq_tpu.utils.profiling import trace
+        try:
+            with trace(label, dir=out_dir):
+                time.sleep(duration_s)
+        except Exception:  # noqa: BLE001 — a failed capture must not wedge
+            log.exception("profile capture failed (%s)", info["path"])
+        finally:
+            with _PROFILE_LOCK:
+                _PROFILE_LAST = dict(info)
+                _PROFILE_LAST["finished"] = time.time()
+                _PROFILE_ACTIVE = None
+
+    threading.Thread(target=run, name="llmq-profile", daemon=True).start()
+    return dict(info)
+
+
+def profile_status() -> Dict[str, Any]:
+    """Current capture state for the admin route: the active capture
+    descriptor (if any) plus the last finished one."""
+    with _PROFILE_LOCK:
+        return {
+            "active": _PROFILE_ACTIVE is not None,
+            "capture": dict(_PROFILE_ACTIVE) if _PROFILE_ACTIVE else None,
+            "last": dict(_PROFILE_LAST) if _PROFILE_LAST else None,
+        }
+
+
+__all__: List[str] = [
+    "DeviceTelemetry", "ProfileInProgress", "decode_mfu", "flush_all",
+    "get_device_telemetry", "measure_rtt", "peak_flops",
+    "profile_status", "reset_telemetry", "start_profile",
+]

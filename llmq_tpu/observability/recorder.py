@@ -43,6 +43,9 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 from llmq_tpu.observability.trace import trace_id_for
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("observability.recorder")
 
 #: Stages that end a request's lifecycle (first one finalizes metrics).
 #: ``cancelled`` (client closed the stream / gave up) is terminal but is
@@ -306,7 +309,12 @@ class FlightRecorder:
                             tl.label("priority", "unknown"),
                             tl.label("endpoint",
                                      tl.label("engine", "local")),
-                            tl.breached))
+                            tl.breached,
+                            dur,
+                            # Terminal wall time: the SLO windows must
+                            # see WHEN the request finished, not when
+                            # the next scrape drained the backlog.
+                            evt.ts))
 
     def merge(self, request_id: str,
               events: List[Dict[str, Any]]) -> None:
@@ -363,10 +371,15 @@ class FlightRecorder:
                 m.flightrecorder_timelines.set(len(self._ring))
                 m.flightrecorder_slow_retained.set(len(self._slow))
             return 0
+        try:
+            from llmq_tpu.observability.slo import get_slo_tracker
+            slo = get_slo_tracker()
+        except Exception:  # noqa: BLE001 — SLO plane must not fail scrapes
+            slo = None
         n = 0
         while True:
             try:
-                lat, prio, endpoint, breached = \
+                lat, prio, endpoint, breached, dur_ms, done_ts = \
                     self._pending_metrics.popleft()
             except IndexError:
                 break
@@ -392,6 +405,13 @@ class FlightRecorder:
                     fam.observe(secs)
             if breached:
                 labeled["sla_breaches"].inc()
+            if slo is not None:
+                # Same deferred cadence as the histograms: the SLO
+                # burn-rate windows are fed per finalized timeline,
+                # stamped at the request's COMPLETION time (a scrape
+                # outage must not compress the drained backlog into
+                # the fast-burn window).
+                slo.observe_request(lat, prio, dur_ms, ts=done_ts)
             n += 1
         with self._mu:
             m.flightrecorder_timelines.set(len(self._ring))
@@ -475,6 +495,25 @@ def configure(cfg) -> FlightRecorder:
                     sla_ms=getattr(cfg, "sla_ms", None),
                     enabled=getattr(cfg, "enabled", None))
     rec.emit_metrics = bool(getattr(cfg, "emit_metrics", True))
+    slo_cfg = getattr(cfg, "slo", None)
+    if slo_cfg is not None:
+        from llmq_tpu.observability.slo import configure_slo, get_slo_tracker
+        if rec.enabled and rec.emit_metrics:
+            configure_slo(slo_cfg)
+        else:
+            # The SLO plane is FED by this recorder's metrics flush —
+            # with the trace plane (or its metric emission) off, the
+            # tracker would starve and report 0 burn forever while
+            # requests breach. Disabling it makes that state VISIBLE
+            # (no targets in engine-stats/overview snapshots) instead
+            # of false-healthy.
+            get_slo_tracker().reconfigure(targets={})
+            if getattr(slo_cfg, "enabled", True):
+                log.warning(
+                    "observability.slo is enabled but the trace plane "
+                    "is not (enabled=%s emit_metrics=%s) — SLO burn "
+                    "rates have no feed and are disabled",
+                    rec.enabled, rec.emit_metrics)
     return rec
 
 

@@ -38,10 +38,12 @@ def trace_dir() -> Optional[str]:
 
 
 @contextmanager
-def trace(label: str = "llmq") -> Iterator[None]:
+def trace(label: str = "llmq", dir: Optional[str] = None) -> Iterator[None]:
     """Capture a jax.profiler trace of the region if LLMQ_TRACE_DIR is
-    set; no-op otherwise. Safe on any backend."""
-    d = trace_dir()
+    set (or an explicit ``dir`` is given — the on-demand
+    ``POST /api/v1/admin/profile`` path); no-op otherwise. Safe on any
+    backend."""
+    d = dir or trace_dir()
     if not d:
         yield
         return

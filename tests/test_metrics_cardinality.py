@@ -1,0 +1,98 @@
+"""Metrics-cardinality guard (ISSUE 6 satellite).
+
+Prometheus label sets must be BOUNDED: a label that ever carries a
+per-request value (request id, UUID, conversation id) grows one time
+series per request and kills the scrape. This suite walks every
+collector in the registry and enforces the contract declared next to
+the families themselves (``metrics.registry.LABEL_CONTRACT``):
+
+- every label name any family uses must be declared in the contract;
+- labels declared as closed enums may only ever carry values from the
+  enum;
+- config/hardware-bounded labels (engine, endpoint, chip, program …)
+  must never carry values that look like request/trace identifiers.
+
+Adding a family with a new label without extending the contract fails
+here by design — the reviewer then decides whether the set is bounded.
+"""
+
+from __future__ import annotations
+
+import re
+
+from llmq_tpu.metrics.registry import (LABEL_CONTRACT, REGISTRY,
+                                       get_metrics)
+
+#: Values that smell like per-request identifiers: UUIDs, long hex,
+#: long digit runs (message ids, timestamps).
+_ID_RX = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$"
+    r"|^[0-9a-f]{12,}$"
+    r"|^\d{6,}$",
+    re.IGNORECASE)
+
+#: Window labels ("5m", "1h", "90s") — bounded by the configured
+#: window list, validated by shape.
+_WINDOW_RX = re.compile(r"^\d{1,5}[smh]$")
+
+
+def _families():
+    get_metrics()   # ensure every family exists
+    return list(REGISTRY.collect())
+
+
+class TestLabelContract:
+    def test_every_label_is_declared(self):
+        undeclared = {}
+        for fam in _families():
+            for sample in fam.samples:
+                unknown = set(sample.labels) - set(LABEL_CONTRACT)
+                if unknown:
+                    undeclared.setdefault(fam.name, set()).update(unknown)
+        # Histograms add "le" internally; it is prometheus-bounded.
+        undeclared = {k: v - {"le"} for k, v in undeclared.items()}
+        undeclared = {k: v for k, v in undeclared.items() if v}
+        assert not undeclared, (
+            f"families using labels absent from LABEL_CONTRACT: "
+            f"{undeclared} — declare them (enum or bounded-by-config) "
+            f"in metrics/registry.py")
+
+    def test_enum_labels_stay_within_their_enum(self):
+        violations = []
+        for fam in _families():
+            for sample in fam.samples:
+                for label, value in sample.labels.items():
+                    allowed = LABEL_CONTRACT.get(label)
+                    if isinstance(allowed, frozenset) \
+                            and value not in allowed:
+                        violations.append((fam.name, label, value))
+        assert not violations, (
+            f"label values outside their declared enum: {violations}")
+
+    def test_bounded_labels_never_carry_request_ids(self):
+        violations = []
+        for fam in _families():
+            for sample in fam.samples:
+                for label, value in sample.labels.items():
+                    if label == "le" or isinstance(
+                            LABEL_CONTRACT.get(label), frozenset):
+                        continue
+                    if label == "window":
+                        if not _WINDOW_RX.match(value):
+                            violations.append((fam.name, label, value))
+                        continue
+                    if _ID_RX.match(value) or len(value) > 128:
+                        violations.append((fam.name, label, value))
+        assert not violations, (
+            f"id-shaped values on bounded labels (unbounded "
+            f"cardinality): {violations}")
+
+    def test_guard_actually_rejects_a_request_id(self):
+        # The detector itself must catch the canonical mistakes, or
+        # the two tests above are vacuous.
+        assert _ID_RX.match("8c94e42e-6f3f-4a73-a18f-000000000001")
+        assert _ID_RX.match("a3f9c2e4b1d05876")
+        assert _ID_RX.match("1785755681")
+        assert not _ID_RX.match("engine0")
+        assert not _ID_RX.match("prefill_b512")
+        assert not _ID_RX.match("tpu-host-a:8080")

@@ -414,6 +414,66 @@ class TestLogContext:
             reset_log_context(token)
         assert "request_id" not in seen["ctx"]
 
+    def test_worker_thread_context_resets_after_each_dispatch(self):
+        """Audit pin (ISSUE 6 satellite): ``Worker._run_one`` must
+        leave its thread's log context EXACTLY as it found it after
+        every dispatch — success or failure. Worker pool threads are
+        reused across requests, so a leaked binding would stamp request
+        B's log lines with request A's identity. The audit found the
+        bind/reset pair correct (reset in ``finally``); this test pins
+        it against regression."""
+        from llmq_tpu.queueing.queue_manager import QueueManager
+        from llmq_tpu.queueing.worker import Worker
+        from llmq_tpu.utils.logging import current_log_context
+
+        seen = []
+
+        def fn(ctx, msg):
+            seen.append(current_log_context())
+            if msg.content == "boom":
+                raise RuntimeError("boom")
+
+        cfg = default_config()
+        cfg.queue.enable_metrics = False
+        mgr = QueueManager("ctx-audit", config=cfg)
+        worker = Worker("ctx-audit", mgr, fn)
+        try:
+            reset_log_context()   # known-clean baseline on this thread
+
+            mgr.push_message(Message(id="ctx-a", content="ok",
+                                     conversation_id="conv-a",
+                                     timeout=5.0))
+            worker.process_one_sync(mgr.pop_message("normal"))
+            # Bound during the dispatch, gone after it.
+            assert seen[0].get("request_id") == "ctx-a"
+            assert seen[0].get("conversation_id") == "conv-a"
+            assert current_log_context() == {}
+
+            # Failure path: the reset runs in a finally, so a raising
+            # process_fn must not leak either.
+            mgr.push_message(Message(id="ctx-b", content="boom",
+                                     timeout=5.0))
+            worker.process_one_sync(mgr.pop_message("normal"))
+            assert seen[1].get("request_id") == "ctx-b"
+            # No bleed of the PREVIOUS request's fields into this one.
+            assert seen[1].get("conversation_id") != "conv-a"
+            assert current_log_context() == {}
+
+            # Nested on top of an outer binding: the token restore must
+            # bring back exactly the outer context, not empty it.
+            outer = bind_log_context(service="gateway")
+            try:
+                mgr.push_message(Message(id="ctx-c", content="ok",
+                                         timeout=5.0))
+                worker.process_one_sync(mgr.pop_message("normal"))
+                assert seen[2].get("request_id") == "ctx-c"
+                assert seen[2].get("service") == "gateway"  # merged
+                assert current_log_context() == {"service": "gateway"}
+            finally:
+                reset_log_context(outer)
+        finally:
+            worker.stop()
+
     def test_worker_binds_request_context(self):
         from llmq_tpu.core.types import Priority
         from llmq_tpu.queueing.queue_manager import QueueManager

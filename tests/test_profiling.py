@@ -142,3 +142,101 @@ class TestDeviceTrace:
         assert h.done
         stats = eng.get_stats()
         assert "engine.prefill" in stats["profile"]
+
+    def test_explicit_dir_overrides_missing_env(self, tmp_path,
+                                                monkeypatch):
+        # The on-demand profile endpoint path: no ambient LLMQ_TRACE_DIR,
+        # capture goes where the caller says.
+        monkeypatch.delenv("LLMQ_TRACE_DIR", raising=False)
+        import jax.numpy as jnp
+        with trace("ondemand", dir=str(tmp_path)):
+            jnp.zeros(4).block_until_ready()
+        assert (tmp_path / "ondemand").exists()
+
+    def test_annotate_active_and_noop_paths_on_cpu(self, monkeypatch):
+        # Active path: a real TraceAnnotation on the CPU backend is a
+        # harmless no-op region — the body must run exactly once.
+        ran = []
+        with annotate("cpu-region"):
+            ran.append(1)
+        assert ran == [1]
+        # No-op path: annotation construction failing must not lose
+        # the body (the endpoint on a backend without profiler support).
+        import jax
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation",
+                            lambda name: (_ for _ in ()).throw(
+                                RuntimeError("no profiler")))
+        ran = []
+        with annotate("fallback-region"):
+            ran.append(1)
+        assert ran == [1]
+
+
+class TestOnDemandProfile:
+    def _server(self):
+        from llmq_tpu.api.server import ApiServer
+        from llmq_tpu.core.config import default_config
+        return ApiServer(default_config())
+
+    def test_single_flight_409_then_released(self, tmp_path,
+                                             monkeypatch):
+        """POST /api/v1/admin/profile: 202 with the trace path; a
+        concurrent capture 409s; once the capture finishes the flight
+        is released and the trace dir is readable (the acceptance
+        criterion's single-flight contract). The output location is
+        server-controlled (LLMQ_TRACE_DIR / tempdir) — a request-body
+        path would be an arbitrary-write primitive."""
+        import json as _json
+        import time as _time
+
+        from llmq_tpu.observability import device
+        monkeypatch.setenv("LLMQ_TRACE_DIR", str(tmp_path))
+        api = self._server()
+        body = _json.dumps({"duration_ms": 100, "label": "t409",
+                            "dir": "/definitely/not/honored"}).encode()
+        status, out, _ = api.dispatch("POST", "/api/v1/admin/profile",
+                                      body)
+        assert status == 202, out
+        # Body "dir" ignored; capture lands under the operator's dir.
+        assert out["path"].startswith(str(tmp_path))
+        status2, out2, _ = api.dispatch("POST", "/api/v1/admin/profile",
+                                        b"{}")
+        assert status2 == 409
+        assert "already running" in out2["error"]
+        # Bounded wait for release (profiler session start/stop on CPU
+        # costs seconds; the capture itself is 100 ms).
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if not device.profile_status()["active"]:
+                break
+            _time.sleep(0.1)
+        st = device.profile_status()
+        assert not st["active"], "capture never released the flight"
+        assert st["last"]["label"] == "t409"
+        found = [f for _, _, fs in os.walk(out["path"]) for f in fs]
+        assert found, "on-demand capture produced no trace files"
+        # Flight released: a new capture is accepted again.
+        status3, out3, _ = api.dispatch(
+            "POST", "/api/v1/admin/profile",
+            _json.dumps({"duration_ms": 10}).encode())
+        assert status3 == 202, out3
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if not device.profile_status()["active"]:
+                break
+            _time.sleep(0.1)
+        assert not device.profile_status()["active"]
+
+    def test_bad_duration_is_400(self):
+        api = self._server()
+        status, out, _ = api.dispatch(
+            "POST", "/api/v1/admin/profile",
+            b'{"duration_ms": "soon"}')
+        assert status == 400
+
+    def test_status_route_reports_idle(self):
+        api = self._server()
+        status, out, _ = api.dispatch("GET", "/api/v1/admin/profile",
+                                      b"")
+        assert status == 200
+        assert out["active"] in (False, True)
