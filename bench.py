@@ -709,6 +709,13 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         # fold in the warm burst and every earlier rate point.
         dev0_steps = ((engine.get_stats().get("device") or {})
                       .get("steps") or {})
+        # Usage-ledger snapshot for per-phase goodput/waste attribution
+        # (observability/usage.py — the ledger is cumulative, so the
+        # point reports deltas like every other counter here).
+        from llmq_tpu.observability.usage import get_usage_ledger
+        _led = get_usage_ledger()
+        u0 = ((_led.snapshot(top_conversations=0).get("totals") or {})
+              if _led.enabled else {})
         while time.perf_counter() - t_start < dur:
             now = time.perf_counter()
             if now < next_arrival:
@@ -813,6 +820,36 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                 k: _phase_mean(k)
                 for k in ("dispatch_ms", "device_ms", "readback_ms")},
         }
+        # Per-phase usage attribution: device-second and waste deltas
+        # against the phase-start snapshot, plus the rolling goodput as
+        # of phase end (fed by the recorder flush — drive it here, the
+        # bench has no /metrics scraper).
+        if _led.enabled:
+            try:
+                from llmq_tpu.observability.recorder import get_recorder
+                get_recorder().flush_metrics()
+            except Exception:  # noqa: BLE001 — attribution, not a gate
+                pass
+            u1 = (_led.snapshot(top_conversations=0).get("totals")
+                  or {})
+
+            def _du(key: str) -> float:
+                return round((u1.get(key) or 0.0) - (u0.get(key) or 0.0),
+                             6)
+
+            waste = _du("waste_device_seconds")
+            useful = _du("useful_device_seconds")
+            point["usage"] = {
+                "useful_device_s": useful,
+                "waste_device_s": waste,
+                "waste_ratio": (round(waste / (useful + waste), 4)
+                                if useful + waste > 0 else 0.0),
+                "kv_page_s": _du("kv_page_seconds"),
+                "saved_prefill_device_s":
+                    _du("saved_prefill_device_seconds"),
+                "goodput_tokens_per_device_s":
+                    _led.goodput()["tokens_per_device_second"],
+            }
         # The tunnel-free projection: the measured critical path carries
         # ~2 host↔device round-trips (prefill-sample fetch + chunk
         # fetch — see decomp first_sample/tail); on a real TPU VM the

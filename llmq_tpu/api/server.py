@@ -250,6 +250,7 @@ class ApiServer:
         r("GET", f"{v1}/cluster/stats", self.get_cluster_stats)
         r("GET", f"{v1}/cluster/overview", self.get_cluster_overview)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
+        r("GET", f"{v1}/usage", self.get_usage)
         r("POST", f"{v1}/generate", self.generate_sync)
         r("GET", f"{v1}/requests/:id/trace", self.get_request_trace)
         adm = f"{v1}/admin"
@@ -348,13 +349,19 @@ class ApiServer:
         return round(stats.avg_wait_time * backlog_factor, 4)
 
     def _ingest_message(self, data: Dict[str, Any],
-                        conversation_id: str = "") -> Message:
+                        conversation_id: str = "",
+                        tenant_header: str = "") -> Message:
         """Shared submit pipeline: parse → id/timestamps → preprocess →
         analysis metadata → push → conversation update → store."""
         try:
             msg = Message.from_dict(data)
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"invalid message: {e}") from None
+        # Usage-plane billing identity: X-Tenant-Id header wins over
+        # the body field; unset → "default" (docs/observability.md
+        # "Usage & goodput").
+        msg.tenant_id = observability.sanitize_tenant(
+            tenant_header or msg.tenant_id)
         if conversation_id:
             msg.conversation_id = conversation_id
         if not msg.id:
@@ -432,8 +439,10 @@ class ApiServer:
         elif not isinstance(stream, (bool, int)):
             raise ApiError(400, "stream must be a boolean")
         if stream:
-            return self._stream_message(data)
-        msg = self._ingest_message(data)
+            return self._stream_message(
+                data, tenant_header=req.headers.get("x-tenant-id", ""))
+        msg = self._ingest_message(
+            data, tenant_header=req.headers.get("x-tenant-id", ""))
         return 202, {
             "message_id": msg.id,
             "priority": int(msg.priority),
@@ -441,7 +450,8 @@ class ApiServer:
             "estimated_wait": self.estimate_wait(msg.priority),
         }
 
-    def _stream_message(self, data: Dict[str, Any]) -> Tuple[int, Any]:
+    def _stream_message(self, data: Dict[str, Any],
+                        tenant_header: str = "") -> Tuple[int, Any]:
         """``POST /api/v1/messages`` with ``"stream": true`` — token
         streaming over SSE (SURVEY §7 bridge design: "tokens-out +
         streaming"). The message bypasses the queue plane and goes
@@ -474,6 +484,8 @@ class ApiServer:
             msg = Message.from_dict(data)
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"invalid message: {e}") from None
+        msg.tenant_id = observability.sanitize_tenant(
+            tenant_header or msg.tenant_id)
         if self.shedder is not None:
             # Engine-down / SLA shedding for streams (no manager: the
             # stream cap + backlog gates below are the queue-side
@@ -583,14 +595,20 @@ class ApiServer:
                               if res and res.finish_reason in
                               ("eos", "length") else MessageStatus.FAILED)
                 msg.updated_at = time.time()
+                usage = {
+                    "prompt_tokens": res.prompt_tokens if res else 0,
+                    "completion_tokens": len(res.tokens) if res else 0,
+                }
+                if handle.usage is not None:
+                    # Attribution ledger summary (docs/observability.md
+                    # "Usage & goodput"): the stream's final event
+                    # carries what this request cost.
+                    usage.update(handle.usage)
                 done = {
                     "message_id": msg.id,
                     "finish_reason": res.finish_reason if res else "timeout",
                     "first_token_ms": first_ms,
-                    "usage": {
-                        "prompt_tokens": res.prompt_tokens if res else 0,
-                        "completion_tokens": len(res.tokens) if res else 0,
-                    },
+                    "usage": usage,
                 }
                 yield "event: done\ndata: " + json.dumps(done) + "\n\n"
             except GeneratorExit:
@@ -686,7 +704,9 @@ class ApiServer:
 
     def add_message_to_conversation(self, req: _Request) -> Tuple[int, Any]:
         conv_id = req.params["id"]
-        msg = self._ingest_message(req.json(), conversation_id=conv_id)
+        msg = self._ingest_message(
+            req.json(), conversation_id=conv_id,
+            tenant_header=req.headers.get("x-tenant-id", ""))
         return 202, {
             "message_id": msg.id,
             "conversation_id": conv_id,
@@ -880,7 +900,43 @@ class ApiServer:
             out["slo"] = get_slo_tracker().snapshot()
         except Exception:  # noqa: BLE001 — stats must not fail on SLO plane
             pass
+        try:
+            # Usage rollups ride the same payload (the cluster overview
+            # aggregates them per replica).
+            from llmq_tpu.observability.usage import get_usage_ledger
+            led = get_usage_ledger()
+            if led.enabled:
+                out["usage"] = led.snapshot(top_conversations=0)
+        except Exception:  # noqa: BLE001 — stats must not fail on usage plane
+            pass
         return 200, out
+
+    def get_usage(self, req: _Request) -> Tuple[int, Any]:
+        """Usage-ledger rollups (docs/observability.md "Usage &
+        goodput"): per-tenant/priority/engine device-seconds, KV
+        page-seconds, waste decomposition and the rolling goodput.
+        ``?tenant=`` narrows to one tenant's rollup."""
+        from llmq_tpu.observability.usage import get_usage_ledger
+        led = get_usage_ledger()
+        if not led.enabled:
+            raise ApiError(503, "usage plane disabled "
+                                "(set observability.usage.enabled)")
+        try:
+            # Drain the recorder's deferred feed first so the goodput
+            # join reflects every finished request even when nothing
+            # scrapes /metrics (same discipline as the SLO surfaces).
+            observability.get_recorder().flush_metrics()
+        except Exception:  # noqa: BLE001 — usage must not fail on trace plane
+            pass
+        snap = led.snapshot()
+        tenant = req.q("tenant")
+        if tenant:
+            return 200, {
+                "tenant": tenant,
+                "usage": snap["tenants"].get(tenant),
+                "goodput": snap["goodput"],
+            }
+        return 200, snap
 
     def get_cluster_overview(self, req: _Request) -> Tuple[int, Any]:
         """Cluster-wide device-telemetry rollup: per-replica MFU, tok/s,

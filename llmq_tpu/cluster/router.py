@@ -282,6 +282,14 @@ class ClusterRouter(EngineRouter):
                     self.failovers += 1
                 if self._metrics:
                     self._metrics.cluster_failovers.labels(ep.id).inc()
+                # Usage plane: a LOCAL engine's partial work for this
+                # attempt is failover waste. A remote replica's fault
+                # must NOT annotate this process's ledger — it bills
+                # its own, and a parked "failover" cause here would
+                # mislabel a later local finalize of the same id
+                # (e.g. a post-failover cancel).
+                if ep.id == self._local_endpoint_id:
+                    observability.get_usage_ledger().note_failover(msg.id)
                 observability.record(msg.id, "failover", endpoint=ep.id,
                                      error=repr(e))
                 log.warning("dispatch of %s to %s failed (%s); "
@@ -421,6 +429,22 @@ class ClusterRouter(EngineRouter):
                     for k in ("name", "slots", "active", "pending",
                               "decode_steps", "tokens_generated",
                               "kv_pages_used", "kv_pages_total")}
+                if stats.get("usage") is not None:
+                    # Remote replicas attach their usage-ledger
+                    # snapshot to engine/stats (api layer injection).
+                    entry["usage"] = stats["usage"]
+                elif remote is None:
+                    # LOCAL engines: this process's ledger (same
+                    # locality rule as the SLO block below).
+                    try:
+                        from llmq_tpu.observability.usage import \
+                            get_usage_ledger
+                        led = get_usage_ledger()
+                        if led.enabled:
+                            entry["usage"] = led.snapshot(
+                                top_conversations=0)
+                    except Exception:  # noqa: BLE001 — rollup survives
+                        pass
                 if stats.get("slo") is not None:
                     # Remote replicas attach their SLO snapshot to
                     # engine/stats — roll it up per replica.
@@ -456,7 +480,21 @@ class ClusterRouter(EngineRouter):
         agg_tok_s = 0.0
         mfus = []
         occupancies = []
+        # Cluster-wide usage rollup: sum the replicas' ledger totals
+        # and token-weight their goodput windows.
+        u_device = u_waste = 0.0
+        gp_tokens = gp_device = 0.0
+        usage_reporting = 0
         for entry in replicas:
+            usage = entry.get("usage")
+            if usage:
+                usage_reporting += 1
+                tot = usage.get("totals") or {}
+                u_device += tot.get("device_seconds") or 0.0
+                u_waste += tot.get("waste_device_seconds") or 0.0
+                gp = usage.get("goodput") or {}
+                gp_tokens += gp.get("tokens_slo_met") or 0
+                gp_device += gp.get("device_seconds") or 0.0
             dev = entry.get("device")
             if not dev:
                 continue
@@ -477,6 +515,16 @@ class ClusterRouter(EngineRouter):
                                  if mfus else 0.0),
                 "max_kv_pool_occupancy": (round(max(occupancies), 4)
                                           if occupancies else 0.0),
+                "usage": {
+                    "reporting": usage_reporting,
+                    "device_seconds": round(u_device, 6),
+                    "waste_device_seconds": round(u_waste, 6),
+                    "waste_ratio": (round(u_waste / u_device, 4)
+                                    if u_device > 0 else 0.0),
+                    "goodput_tokens_per_device_second": (
+                        round(gp_tokens / gp_device, 3)
+                        if gp_device > 0 else 0.0),
+                },
             },
         }
 

@@ -308,6 +308,23 @@ class SloConfig:
 
 
 @dataclass
+class UsageConfig:
+    """Usage plane: per-request resource attribution, goodput and waste
+    decomposition (observability/usage.py, docs/observability.md
+    "Usage & goodput"). ``enabled: false`` is a hard off-switch: the
+    engine's charge points reduce to one attribute check and the
+    ledger records nothing."""
+    enabled: bool = True
+    #: Distinct tenant ids that get their own Prometheus label before
+    #: overflow collapses to "other" (JSON rollups keep exact ids).
+    max_tenants: int = 64
+    #: Per-conversation rollups kept (LRU).
+    max_conversations: int = 1024
+    #: Rolling window for the goodput gauge (seconds).
+    goodput_window_s: float = 300.0
+
+
+@dataclass
 class ObservabilityConfig:
     """Request-lifecycle trace plane (llmq_tpu/observability/,
     docs/observability.md). ``enabled: false`` is a hard off-switch:
@@ -331,6 +348,9 @@ class ObservabilityConfig:
     propagate_trace: bool = True
     #: SLO targets / burn-rate windows (observability/slo.py).
     slo: SloConfig = field(default_factory=SloConfig)
+    #: Usage plane: attribution ledger, goodput, waste decomposition
+    #: (observability/usage.py).
+    usage: UsageConfig = field(default_factory=UsageConfig)
 
 
 @dataclass

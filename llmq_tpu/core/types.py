@@ -109,6 +109,11 @@ class Message:
     id: str = field(default_factory=new_id)
     conversation_id: str = ""
     user_id: str = ""
+    #: Billing/quota identity for the usage plane (docs/observability.md
+    #: "Usage & goodput"): set from the ``X-Tenant-Id`` header or the
+    #: request body; ``"default"`` when unset. Bounded at the metric
+    #: layer (max_tenants + overflow → "other"), exact in rollups.
+    tenant_id: str = "default"
     content: str = ""
     priority: Priority = Priority.NORMAL
     status: MessageStatus = MessageStatus.PENDING
@@ -139,6 +144,7 @@ class Message:
             "id": self.id,
             "conversation_id": self.conversation_id,
             "user_id": self.user_id,
+            "tenant_id": self.tenant_id,
             "content": self.content,
             "priority": int(self.priority),
             "status": self.status.value,

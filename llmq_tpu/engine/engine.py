@@ -56,6 +56,9 @@ from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from llmq_tpu.metrics.registry import get_metrics
 from llmq_tpu.observability.device import get_device_telemetry
+from llmq_tpu.observability.usage import (DEFAULT_TENANT, RequestUsage,
+                                          get_usage_ledger,
+                                          sanitize_tenant)
 from llmq_tpu.utils.logging import get_logger
 from llmq_tpu.utils.profiling import SpanRecorder
 
@@ -86,6 +89,10 @@ class GenRequest:
     history_text: str = ""       # full-history fallback on conversation KV miss
     max_new_tokens: int = 0      # 0 → engine default
     temperature: float = 0.0
+    #: Billing identity for the usage plane (docs/observability.md
+    #: "Usage & goodput") — who this request's hardware consumption is
+    #: attributed to.
+    tenant_id: str = DEFAULT_TENANT
 
     @classmethod
     def from_message(cls, msg: Message) -> "GenRequest":
@@ -98,6 +105,7 @@ class GenRequest:
             history_text=str(md.get("history_text", "")),
             max_new_tokens=int(md.get("max_new_tokens", 0) or 0),
             temperature=float(md.get("temperature", 0.0) or 0.0),
+            tenant_id=sanitize_tenant(getattr(msg, "tenant_id", "")),
         )
 
 
@@ -125,6 +133,11 @@ class GenHandle:
         #: committed host-side). Feeds the bench's per-request latency
         #: decomposition and the API's first-token metric.
         self.marks: Dict[str, float] = {}
+        #: Per-request usage attribution (observability/usage.py),
+        #: filled at finish when the usage plane is enabled:
+        #: device_seconds, waste_seconds(+reason), kv_page_seconds,
+        #: saved_prefill_device_seconds, tenant.
+        self.usage: Optional[Dict] = None
         self._on_token = None
         self._done = threading.Event()
         self._cancelled = threading.Event()
@@ -173,7 +186,8 @@ class _Sequence:
                  "prefill_start", "carry", "written_ids", "rebuild",
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
                  "first_handle", "eff_prio", "arrival", "prefix_match",
-                 "reuse_counted", "mixed_pending", "pf_tokens_run")
+                 "reuse_counted", "mixed_pending", "pf_tokens_run",
+                 "usage")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -232,6 +246,11 @@ class _Sequence:
         #: Prefill tokens actually run for this admission (all dispatch
         #: paths) — feeds the learned prefill-rate EWMA at completion.
         self.pf_tokens_run = 0
+        #: Usage-plane accumulator (observability/usage.py): charged by
+        #: the engine thread with this sequence's pro-rata share of
+        #: every measured chunk; None with the plane disabled (the hard
+        #: off-switch — every charge point is then one None check).
+        self.usage: Optional[RequestUsage] = None
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -349,6 +368,11 @@ class InferenceEngine:
         #: decode-rate source; engine-local so metrics-off benches can
         #: still read it).
         self.tokens_generated_total = 0
+        #: Usage plane (observability/usage.py): the process-wide
+        #: attribution ledger this engine charges. Hard off-switch:
+        #: with ``observability.usage.enabled`` false every charge
+        #: point below reduces to one attribute check.
+        self._usage = get_usage_ledger()
 
         self.allocator = PageAllocator(self.spec.num_pages,
                                        self.spec.page_size)
@@ -450,6 +474,8 @@ class InferenceEngine:
             handle.on_token(on_token)
         seq = _Sequence(req, handle, next(self._order),
                         self.spec.max_pages_per_seq)
+        if self._usage.enabled:
+            seq.usage = RequestUsage()
         with self._mu:
             self._inbox.append(seq)
         self._wake.set()
@@ -493,12 +519,18 @@ class InferenceEngine:
         if res.finish_reason == "cancelled":
             raise RuntimeError("request cancelled")
         msg.response = res.text
-        msg.metadata["usage"] = {
+        usage = {
             "prompt_tokens": res.prompt_tokens,
             "cached_tokens": res.cached_tokens,
             "completion_tokens": len(res.tokens),
             "finish_reason": res.finish_reason,
         }
+        if handle.usage is not None:
+            # Attribution ledger summary (observability/usage.py):
+            # rides the generate_sync response back to the gateway, so
+            # cross-host callers see their cost too.
+            usage.update(handle.usage)
+        msg.metadata["usage"] = usage
 
     # -- conversation service hooks (BASELINE config #3) ---------------------
 
@@ -537,6 +569,8 @@ class InferenceEngine:
         kv = self._conv_cache.pop(conv_id, None)
         if kv is not None:
             self.allocator.unpin(conv_id)
+            if self._usage.enabled:
+                self._usage.unpin_kv(conv_id)
             self.allocator.free(kv.pages)
             streams.append(kv.tokens)
         if self._prefix_cache is not None and streams:
@@ -662,7 +696,8 @@ class InferenceEngine:
                     seq.pages = []
                 continue
             self._finish(seq, "error",
-                         "engine crashed; request requeued by supervisor")
+                         "engine crashed; request requeued by supervisor",
+                         waste_reason="crash")
             recovered += 1
         self._wake.clear()
         log.warning(
@@ -954,9 +989,17 @@ class InferenceEngine:
                      "request_id": victim.req.id,
                      "conversation_id": victim.req.conversation_id}})
 
-    def _release_sequence_pages(self, seq: _Sequence) -> None:
+    def _release_sequence_pages(self, seq: _Sequence,
+                                waste_reason: str = "preempt") -> None:
         """Take ``seq``'s KV pages back into the pool. The sequence will
-        rebuild by re-prefilling ``written_ids`` when next admitted."""
+        rebuild by re-prefilling ``written_ids`` when next admitted —
+        device time that the usage plane bills as waste under
+        ``waste_reason`` ("preempt" for a priority preemption, "shed"
+        for pool-pressure reclaim of a pending sequence)."""
+        if seq.usage is not None:
+            if not seq.usage.waste_reason:
+                seq.usage.waste_reason = waste_reason
+            self._usage.tracker.update(seq.req.id, 0)
         if seq.prefix_match is not None:
             # The shed pages include radix-matched shared pages: drop
             # their in-flight node pins (the free below drops this
@@ -996,6 +1039,8 @@ class InferenceEngine:
         seq.block_table[:] = 0
         seq.pos = 0
         seq.cached_len = 0
+        if seq.usage is not None:
+            self._usage.tracker.update(seq.req.id, 0)
 
     def _reclaim_idle_conversation(self) -> bool:
         """LRU-evict one idle pinned conversation to relieve pool
@@ -1024,7 +1069,7 @@ class InferenceEngine:
                 worst = seq
         if worst is None or worst.sort_key() <= requester.sort_key():
             return False
-        self._release_sequence_pages(worst)
+        self._release_sequence_pages(worst, waste_reason="shed")
         log.info("reclaimed pages of pending %s for %s",
                  worst.req.id, requester.req.id,
                  extra={"fields": {"request_id": requester.req.id,
@@ -1091,6 +1136,10 @@ class InferenceEngine:
                         self.allocator.unpin(conv)
                     self._conv_busy[conv] = seq.order
                 seq.adopted = True
+                if kv is not None and self._usage.enabled:
+                    # The pin's page-second meter ends here; the pages
+                    # continue on THIS sequence's meter below.
+                    self._usage.unpin_kv(conv)
                 if kv is not None:
                     seq.cached_len = kv.length
                     seq.pos = kv.length
@@ -1200,6 +1249,10 @@ class InferenceEngine:
                         # holding a partial match here would replay the
                         # matched tokens at shifted positions).
                         self._unmatch(seq)
+                    elif seq.pages:
+                        # Still pending WITH pages (adopted KV kept for
+                        # the retry): meter them while it waits.
+                        self._usage_pages(seq)
                     return False
                 seq.block_table[have:have + need] = pages
                 seq.pages.extend(pages)
@@ -1241,6 +1294,7 @@ class InferenceEngine:
             seq.slot = slot
             self._slots[slot] = seq        # slot held; prefilled=False
             seq.handle.marks.setdefault("admitted", time.perf_counter())
+            self._usage_pages(seq)
             return True
         # Resuming a slot-only preemption: KV intact, just take the slot
         # (per-slot-state executors re-register their context).
@@ -1491,6 +1545,7 @@ class InferenceEngine:
             return False
         seq.block_table[len(seq.pages):len(seq.pages) + need] = pages
         seq.pages.extend(pages)
+        self._usage_pages(seq)
         return True
 
     def _admission_cap(self) -> int:
@@ -1707,6 +1762,7 @@ class InferenceEngine:
                 assert pages is not None    # checked above
                 seq.block_table[len(seq.pages):len(seq.pages) + need] = pages
                 seq.pages.extend(pages)
+                self._usage_pages(seq)
             budgets[slot] = b
             block_tables[slot] = seq.block_table
             temps[slot] = seq.req.temperature
@@ -1825,6 +1881,51 @@ class InferenceEngine:
             self.stall_events += 1
             self.stall_ms_total += (time.perf_counter() - t0) * 1e3
 
+    # -- usage attribution (observability/usage.py) ---------------------------
+
+    def _charge_step(self, device_s: float, parts) -> None:
+        """Split one measured chunk's device-execute seconds pro-rata
+        across the rows/slices that rode it. ``parts`` is
+        ``[(seq, weight, waste)]`` — weight is decode budget or slice
+        tokens; ``waste`` marks rebuild re-prefill (work a preemption/
+        shed already paid for once). Plain float adds on the engine
+        thread; the ledger sees one conservation note per chunk."""
+        u = self._usage
+        if not u.enabled or device_s <= 0:
+            return
+        total_w = 0
+        for _, w, _ in parts:
+            total_w += w
+        attributed = 0.0
+        if total_w > 0:
+            for seq, w, waste in parts:
+                ru = seq.usage
+                if ru is None:
+                    continue
+                share = device_s * (w / total_w)
+                if waste:
+                    ru.waste_s += share
+                    if not ru.waste_reason:
+                        ru.waste_reason = "preempt"
+                else:
+                    ru.device_s += share
+                attributed += share
+        u.note_step(device_s, attributed)
+
+    def _usage_pages(self, seq: _Sequence) -> None:
+        """Refresh the page-seconds tracker with ``seq``'s current
+        holding: radix-matched pages are SHARED (fractional charge
+        across sharers), the rest exclusive. Called after every
+        page-set mutation — admission/growth/release-shaped events,
+        never per token."""
+        u = self._usage
+        if not u.enabled or seq.usage is None:
+            return
+        shared = (seq.prefix_match.pages
+                  if seq.prefix_match is not None else ())
+        u.tracker.update(seq.req.id,
+                         len(seq.pages) - len(shared), shared)
+
     def _process_chunk(self, infl: _InflightChunk) -> None:
         """Commit an in-flight chunk's tokens. Uses the dispatch-time
         snapshot; cancellations are deliberately NOT acted on here (the
@@ -1860,6 +1961,20 @@ class InferenceEngine:
         pf_first = None
         if infl.pf is not None:
             out, pf_first = out      # mixed chunk: (decode, slice firsts)
+        if self._usage.enabled:
+            # Attribute BEFORE committing: rows that finish during the
+            # commit loop (EOS) finalize their ledger record there and
+            # must already carry this chunk's share.
+            parts = []
+            for slot in range(self.spec.batch_size):
+                seq = infl.seqs[slot]
+                if seq is not None and seq.slot == slot:
+                    parts.append((seq, max(1, int(infl.budgets[slot])),
+                                  False))
+            if infl.pf is not None:
+                for seq, n_tok, _final in infl.pf:
+                    parts.append((seq, n_tok, seq.todo_rebuild))
+            self._charge_step(device_s, parts)
         tok0 = self.tokens_generated_total
         for slot in range(self.spec.batch_size):
             seq = infl.seqs[slot]
@@ -2000,6 +2115,11 @@ class InferenceEngine:
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
+        if self._usage.enabled:
+            self._charge_step(t_done - t_call,
+                              [(seq, max(1, int(budgets[seq.slot])),
+                                False)
+                               for seq in active if seq.slot is not None])
         tok0 = self.tokens_generated_total
         for seq in active:
             self._commit_row(seq, out[seq.slot], int(budgets[seq.slot]))
@@ -2152,6 +2272,12 @@ class InferenceEngine:
         self.mixed_prefill_tokens_total += packed
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
+        if self._usage.enabled:
+            parts = [(seq, max(1, int(budgets[seq.slot])), False)
+                     for seq in active if seq.slot is not None]
+            parts.extend((seq, n_tok, seq.todo_rebuild)
+                         for seq, n_tok, _final in infl_pf)
+            self._charge_step(t_done - t0, parts)
         tok0 = self.tokens_generated_total
         for seq in active:
             if seq.slot is not None:
@@ -2267,6 +2393,12 @@ class InferenceEngine:
                         pending=(seq.last_token if reason == "length"
                                  else None))
                     self.allocator.pin(conv, seq.pages)
+                    if self._usage.enabled:
+                        # Between-turns KV residency: the request's own
+                        # meter closes at _finish; the pin meter bills
+                        # the conversation/tenant until adoption/drop.
+                        self._usage.pin_kv(conv, len(seq.pages),
+                                           seq.req.tenant_id)
                     if self._prefix_cache is not None:
                         handle_rec = {"length": seq.pos,
                                       "pages": len(seq.pages),
@@ -2307,15 +2439,21 @@ class InferenceEngine:
         terminal = ("completed" if reason in ("eos", "length")
                     else "cancelled" if reason == "cancelled"
                     else "failed")
-        events.append((terminal, time.time(),
-                       {"engine": self.name, "priority": prio,
-                        "finish_reason": reason,
-                        "completion_tokens": len(seq.generated),
-                        "prompt_tokens": len(seq.prompt_ids),
-                        "cached_tokens": seq.cached_len}))
+        meta = {"engine": self.name, "priority": prio,
+                "finish_reason": reason,
+                "completion_tokens": len(seq.generated),
+                "prompt_tokens": len(seq.prompt_ids),
+                "cached_tokens": seq.cached_len,
+                "tenant": seq.req.tenant_id}
+        if seq.handle.usage is not None:
+            # Cost next to latency: the trace/flight-recorder surfaces
+            # show this request's attributed usage.
+            meta["usage"] = seq.handle.usage
+        events.append((terminal, time.time(), meta))
         rec.record_many(seq.req.id, events)
 
-    def _finish(self, seq: _Sequence, reason: str, error: str = "") -> None:
+    def _finish(self, seq: _Sequence, reason: str, error: str = "",
+                waste_reason: str = "") -> None:
         if seq.prefix_match is not None:
             self._prefix_cache.unlock(seq.prefix_match)
             seq.prefix_match = None
@@ -2328,6 +2466,29 @@ class InferenceEngine:
                 if self._conv_busy.get(conv) == seq.order:
                     del self._conv_busy[conv]
                 self._conv_drop_pending.discard(conv)
+        if seq.usage is not None and self._usage.enabled:
+            # Close the attribution: page-seconds from the tracker,
+            # prefix-reuse credit from the learned prefill rate, then
+            # one ledger finalize — delivered output keeps its device
+            # time useful; failures/cancellations reclassify ALL of it
+            # as waste (``waste_reason`` pins the cause when the caller
+            # knows it, e.g. "crash" from the supervisor's recovery).
+            ru = seq.usage
+            ru.kv_page_s += self._usage.tracker.close(seq.req.id)
+            if seq.cached_len > 0 and self.prefill_tps_ewma:
+                ru.saved_prefill_device_s = (
+                    seq.cached_len / self.prefill_tps_ewma)
+            seq.handle.usage = self._usage.finalize(
+                seq.req.id, ru,
+                tenant=seq.req.tenant_id,
+                priority=seq.req.priority.tier_name,
+                engine=self.name,
+                conversation=conv,
+                tokens=len(seq.generated),
+                prompt_tokens=len(seq.prompt_ids),
+                ok=reason in ("eos", "length"),
+                waste_reason=waste_reason or (
+                    "cancelled" if reason == "cancelled" else "error"))
         self._record_trace(seq, reason)
         res = GenResult(
             text=self.tokenizer.decode(seq.generated),

@@ -75,8 +75,15 @@ LABEL_CONTRACT = {
                             "fail", "requeue", "retry_stash", "remove"}),
     "status": frozenset({"success", "error", "healthy", "degraded",
                          "unhealthy", "draining"}),
+    "tenant": None,     # client-supplied — bounded by the usage
+                        # ledger (max_tenants + "other" collapse;
+                        # id-shaped values never become labels)
     "reason": frozenset({"affinity", "spill", "select", "failover",
-                         "backlog", "sla", "engine_down"}),
+                         "backlog", "sla", "engine_down",
+                         # usage-plane waste decomposition
+                         # (observability/usage.py WASTE_REASONS):
+                         "retry", "crash", "preempt", "shed",
+                         "cancelled", "error"}),
     "path": frozenset({"mixed", "program"}),
     "point": None,      # compiled-in chaos fault points (fnmatch keys)
     "kind": frozenset({"error", "timeout", "partial", "oserror",
@@ -355,6 +362,37 @@ class QueueMetrics:
             f"{ns}_warmup_progress",
             "Warmup completion fraction (0..1) — programs compiled / "
             "programs planned", ["engine"], registry=registry)
+        # Usage plane (llmq_tpu/observability/usage.py,
+        # docs/observability.md "Usage & goodput"): who consumed the
+        # hardware. ``tenant`` is bounded by the ledger (max_tenants;
+        # overflow and id-shaped values collapse to "other").
+        self.usage_device_seconds = Counter(
+            f"{ns}_usage_device_seconds_total",
+            "Attributed device-execute seconds behind DELIVERED output "
+            "(useful work)", ["tenant", "priority"], registry=registry)
+        self.usage_waste_seconds = Counter(
+            f"{ns}_usage_waste_seconds_total",
+            "Attributed device-execute seconds that bought no delivered "
+            "output, by cause (retry|failover|crash|preempt|shed|"
+            "cancelled|error)", ["reason"], registry=registry)
+        self.usage_kv_page_seconds = Counter(
+            f"{ns}_usage_kv_page_seconds_total",
+            "KV page-seconds held (pages x wall time; shared prefix "
+            "pages charged fractionally to their sharers)", ["tenant"],
+            registry=registry)
+        self.usage_saved_prefill_seconds = Counter(
+            f"{ns}_usage_saved_prefill_device_seconds_total",
+            "Estimated prefill device-seconds SAVED by prefix-cache / "
+            "conversation-KV hits", ["tenant"], registry=registry)
+        self.goodput_tokens_per_device_s = Gauge(
+            f"{ns}_goodput_tokens_per_device_second",
+            "Rolling goodput: SLO-met completion tokens per attributed "
+            "device-second (waste counts in the denominator)",
+            registry=registry)
+        self.usage_tenants_tracked = Gauge(
+            f"{ns}_usage_tenants_tracked",
+            "Distinct tenants with usage rollups this process",
+            registry=registry)
         # SLO layer (llmq_tpu/observability/slo.py): burn rate 1.0 =
         # spending exactly the allowed error budget over the window.
         self.slo_burn_rate = Gauge(
@@ -397,6 +435,14 @@ def exposition() -> bytes:
     try:
         from llmq_tpu.observability.slo import get_slo_tracker
         get_slo_tracker().flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Usage plane: finalized attribution records drain into the
+        # per-tenant/waste counters here, after the recorder flush
+        # above fed the goodput join.
+        from llmq_tpu.observability.usage import get_usage_ledger
+        get_usage_ledger().flush()
     except Exception:  # noqa: BLE001
         pass
     return generate_latest(REGISTRY)

@@ -15,7 +15,10 @@ package makes one request legible across all of them:
   live MFU/decode-rate, HBM accounting, compile-cache visibility,
   single-flight on-demand profiling;
 - :mod:`slo` — config-defined SLO targets and rolling error-budget
-  burn rates, fed from the recorder's finalized timelines.
+  burn rates, fed from the recorder's finalized timelines;
+- :mod:`usage` — the attribution ledger: per-request device-seconds
+  and KV page-seconds, per-tenant rollups, waste decomposition and the
+  rolling goodput gauge.
 
 The usage contract for instrumented layers is one line:
 
@@ -38,6 +41,13 @@ from llmq_tpu.observability.slo import (  # noqa: F401
     SloTracker,
     configure_slo,
     get_slo_tracker,
+)
+from llmq_tpu.observability.usage import (  # noqa: F401
+    RequestUsage,
+    UsageLedger,
+    configure_usage,
+    get_usage_ledger,
+    sanitize_tenant,
 )
 from llmq_tpu.observability.recorder import (  # noqa: F401
     TERMINAL_STAGES,

@@ -162,6 +162,29 @@ class Timeline:
                 return str(v)
         return default
 
+    def _cost(self) -> Dict[str, Any]:
+        """Token counts + usage attribution from the terminal event's
+        meta (the engine stamps both at finish) — so the trace and
+        flight-recorder surfaces show COST next to latency."""
+        tokens: Dict[str, Any] = {}
+        usage: Optional[Dict[str, Any]] = None
+        for e in self.events:
+            if e.stage not in TERMINAL_STAGES:
+                continue
+            for k, name in (("prompt_tokens", "prompt"),
+                            ("completion_tokens", "completion"),
+                            ("cached_tokens", "cached")):
+                if k in e.meta and name not in tokens:
+                    tokens[name] = int(e.meta[k] or 0)
+            if usage is None and isinstance(e.meta.get("usage"), dict):
+                usage = dict(e.meta["usage"])
+        out: Dict[str, Any] = {}
+        if tokens:
+            out["tokens"] = tokens
+        if usage is not None:
+            out["usage"] = usage
+        return out
+
     def to_dict(self) -> Dict[str, Any]:
         lat = self.stage_latencies()
         return {
@@ -177,6 +200,7 @@ class Timeline:
             "stage_latencies_ms": {k: round(v * 1e3, 3)
                                    for k, v in lat.items()},
             "hosts": sorted({e.host for e in self.events}),
+            **self._cost(),
             "events": [e.to_dict() for e in self.sorted_events()],
         }
 
@@ -191,6 +215,7 @@ class Timeline:
             "priority": self.label("priority", "unknown"),
             "endpoint": self.label("endpoint",
                                    self.label("engine", "local")),
+            **self._cost(),
             "events": len(self.events),
         }
 
@@ -305,6 +330,7 @@ class FlightRecorder:
                         # label lookup + observe costs stay off the
                         # request/decode hot path entirely.
                         self._pending_metrics.append((
+                            tl.request_id,
                             tl.stage_latencies(),
                             tl.label("priority", "unknown"),
                             tl.label("endpoint",
@@ -376,10 +402,17 @@ class FlightRecorder:
             slo = get_slo_tracker()
         except Exception:  # noqa: BLE001 — SLO plane must not fail scrapes
             slo = None
+        try:
+            from llmq_tpu.observability.usage import get_usage_ledger
+            usage = get_usage_ledger()
+            if not usage.enabled:
+                usage = None
+        except Exception:  # noqa: BLE001 — usage plane must not fail scrapes
+            usage = None
         n = 0
         while True:
             try:
-                lat, prio, endpoint, breached, dur_ms, done_ts = \
+                rid, lat, prio, endpoint, breached, dur_ms, done_ts = \
                     self._pending_metrics.popleft()
             except IndexError:
                 break
@@ -412,6 +445,12 @@ class FlightRecorder:
                 # outage must not compress the drained backlog into
                 # the fast-burn window).
                 slo.observe_request(lat, prio, dur_ms, ts=done_ts)
+            if usage is not None:
+                # Goodput join (observability/usage.py): the SLO
+                # verdict meets the request's attributed device time
+                # here — the only place both sides exist.
+                usage.observe_request(rid, lat, prio, dur_ms,
+                                      ts=done_ts)
             n += 1
         with self._mu:
             m.flightrecorder_timelines.set(len(self._ring))
@@ -495,6 +534,21 @@ def configure(cfg) -> FlightRecorder:
                     sla_ms=getattr(cfg, "sla_ms", None),
                     enabled=getattr(cfg, "enabled", None))
     rec.emit_metrics = bool(getattr(cfg, "emit_metrics", True))
+    usage_cfg = getattr(cfg, "usage", None)
+    if usage_cfg is not None:
+        from llmq_tpu.observability.usage import configure_usage
+        led = configure_usage(usage_cfg)
+        if led.enabled and not (rec.enabled and rec.emit_metrics):
+            # The goodput join is FED by this recorder's metrics flush
+            # (the only place SLO verdicts meet attributed device
+            # time). Attribution/waste/rollups still work without it —
+            # but the goodput gauge would read a silent 0.0.
+            log.warning(
+                "observability.usage is enabled but the trace plane "
+                "(observability.enabled + emit_metrics) is off: "
+                "goodput_tokens_per_device_second has no feed and "
+                "will stay 0; device-second/waste attribution is "
+                "unaffected")
     slo_cfg = getattr(cfg, "slo", None)
     if slo_cfg is not None:
         from llmq_tpu.observability.slo import configure_slo, get_slo_tracker

@@ -543,6 +543,10 @@ class Worker:
             qname = self.manager.stash_for_retry(msg)
             msg.status = MessageStatus.PENDING
             self.delayed_queue.schedule_after(msg, delay, qname)
+            # Usage plane: the failed attempt's device time is
+            # retried-away work — reclassify its waste from the
+            # engine's generic "error" to "retry".
+            observability.get_usage_ledger().note_retry(msg.id)
             observability.record(msg.id, "retry_scheduled",
                                  priority=msg.priority.tier_name,
                                  retry=msg.retry_count,

@@ -96,3 +96,54 @@ class TestLabelContract:
         assert not _ID_RX.match("engine0")
         assert not _ID_RX.match("prefill_b512")
         assert not _ID_RX.match("tpu-host-a:8080")
+
+
+class TestTenantLabelBound:
+    """The ``tenant`` label is CLIENT-supplied — the one label in the
+    registry an external caller can try to spray. The usage ledger must
+    keep it bounded: at most ``max_tenants`` distinct series, overflow
+    and id-shaped values collapsing to "other"."""
+
+    def test_tenant_spray_collapses_to_other(self):
+        from llmq_tpu.observability.usage import (RequestUsage,
+                                                  get_usage_ledger,
+                                                  reset_usage)
+        reset_usage()
+        led = get_usage_ledger()
+        led.reconfigure(enabled=True, max_tenants=4)
+        try:
+            # 4 legit tenants, then a spray of 50 uuid-ish ids.
+            sprayed = [f"cardtenant-{i}" for i in range(4)] + [
+                f"{i:032x}"[:12] + "deadbeef" for i in range(50)]
+            for i, t in enumerate(sprayed):
+                ru = RequestUsage()
+                ru.device_s = 0.001
+                led.finalize(f"spray-{i}", ru, tenant=t,
+                             priority="normal", engine="cardtest",
+                             ok=True)
+            led.metrics_enabled = True
+            led.flush()
+            seen = set()
+            for fam in _families():
+                if fam.name != "llm_queue_usage_device_seconds":
+                    continue
+                for sample in fam.samples:
+                    t = sample.labels.get("tenant")
+                    if t is not None and t.startswith(
+                            ("cardtenant-", "other")) is False:
+                        # Foreign tenants from other tests are fine;
+                        # only THIS spray's ids must not appear.
+                        assert "deadbeef" not in t, sample
+                    if t is not None:
+                        seen.add(t)
+            assert {f"cardtenant-{i}" for i in range(4)} <= seen
+            assert "other" in seen
+            assert not any("deadbeef" in t for t in seen)
+        finally:
+            reset_usage()
+
+    def test_ledger_enforces_bound_even_for_clean_names(self):
+        from llmq_tpu.observability.usage import UsageLedger
+        led = UsageLedger(max_tenants=2)
+        labels = {led.tenant_label(f"team-{i}") for i in range(10)}
+        assert labels == {"team-0", "team-1", "other"}

@@ -1,0 +1,654 @@
+"""Usage plane (observability/usage.py, docs/observability.md "Usage &
+goodput"): per-request device-second attribution, KV page-seconds with
+fractional shared-page billing, waste decomposition, tenant bounding,
+goodput, the API surface — and the conservation invariant: everything
+the device telemetry measured is attributed somewhere (useful + waste +
+explicitly-unattributed), within 1 %, on echo and CPU-JAX engines,
+including chaos traffic."""
+
+import threading
+import time
+
+import pytest
+
+from llmq_tpu.core.types import Message, Priority
+from llmq_tpu.engine.engine import GenRequest, InferenceEngine
+from llmq_tpu.engine.executor import EchoExecutor
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.observability.recorder import get_recorder
+from llmq_tpu.observability.usage import (PageUsageTracker, RequestUsage,
+                                          UsageLedger, get_usage_ledger,
+                                          reset_usage, sanitize_tenant)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    reset_usage()
+    led = get_usage_ledger()
+    led.reconfigure(enabled=True, max_tenants=64)
+    yield
+    reset_usage()
+
+
+def make_echo_engine(name="usage-echo", slots=4, chunk=4, **kw):
+    tok = ByteTokenizer()
+    ex = EchoExecutor(batch_size=slots, page_size=8, num_pages=256,
+                      max_pages_per_seq=16, eos_id=tok.eos_id,
+                      chunk_size=chunk, mixed_prefill_slices=2,
+                      mixed_slice_tokens=8)
+    return InferenceEngine(ex, tok, name=name, enable_metrics=False,
+                           max_decode_steps=64, **kw)
+
+
+def _conservation(engines, led):
+    """Measured device time vs ledger attribution, both in seconds."""
+    measured = sum(e._telemetry._device.total_ms for e in engines) / 1e3
+    accounted = (led.attributed_device_s + led.unattributed_device_s)
+    return measured, accounted
+
+
+# -- page-seconds tracker (satellite: shared-page attribution) -----------------
+
+
+class TestPageUsageTracker:
+    def test_exclusive_pages_accumulate(self):
+        tr = PageUsageTracker()
+        tr.update("a", 4)
+        time.sleep(0.05)
+        got = tr.close("a")
+        assert got == pytest.approx(4 * 0.05, rel=0.5)
+
+    def test_shared_pages_split_fractionally_never_double_counted(self):
+        tr = PageUsageTracker()
+        # Two sharers of pages {10, 11} plus one exclusive page each.
+        tr.update("a", 1, shared=(10, 11))
+        tr.update("b", 1, shared=(10, 11))
+        time.sleep(0.08)
+        a = tr.close("a")
+        b = tr.close("b")
+        # Each holder: 1 exclusive + 2 shared/2 = 2 page-rates.
+        assert a == pytest.approx(b, rel=0.3)
+        # Physical pages alive: 2 exclusive + 2 shared = 4 page-rates
+        # total — the shared pages are charged ONCE across sharers.
+        assert a + b == pytest.approx(4 * 0.08, rel=0.5)
+
+    def test_resplit_when_a_sharer_completes(self):
+        tr = PageUsageTracker()
+        tr.update("a", 0, shared=(7,))
+        tr.update("b", 0, shared=(7,))
+        time.sleep(0.06)
+        first = tr.close("a")              # a paid 1/2 of page 7 so far
+        time.sleep(0.06)
+        second = tr.close("b")             # b: 1/2 then the whole page
+        assert first == pytest.approx(0.03, rel=0.6)
+        assert second == pytest.approx(0.03 + 0.06, rel=0.6)
+
+    def test_close_unknown_key_is_zero(self):
+        assert PageUsageTracker().close("nope") == 0.0
+
+    def test_update_is_idempotent_for_membership(self):
+        tr = PageUsageTracker()
+        tr.update("a", 2, shared=(5,))
+        tr.update("a", 2, shared=(5,))     # same holding, re-announced
+        time.sleep(0.03)
+        got = tr.close("a")
+        assert got == pytest.approx(3 * 0.03, rel=0.6)
+        assert tr.holders() == 0
+
+
+# -- ledger unit behavior ------------------------------------------------------
+
+
+class TestLedger:
+    def test_finalize_ok_keeps_device_time_useful(self):
+        led = UsageLedger()
+        ru = RequestUsage()
+        ru.device_s = 2.0
+        out = led.finalize("r1", ru, tenant="t1", priority="normal",
+                           engine="e0", tokens=10, ok=True)
+        assert out["device_seconds"] == 2.0
+        assert out["waste_seconds"] == 0.0
+        snap = led.snapshot()
+        assert snap["tenants"]["t1"]["device_seconds"] == 2.0
+        assert snap["totals"]["waste_device_seconds"] == 0
+
+    def test_finalize_failure_reclassifies_all_as_waste(self):
+        led = UsageLedger()
+        ru = RequestUsage()
+        ru.device_s = 1.5
+        ru.waste_s = 0.5
+        out = led.finalize("r1", ru, tenant="t1", priority="normal",
+                           engine="e0", ok=False, waste_reason="crash")
+        assert out["device_seconds"] == 0.0
+        assert out["waste_seconds"] == 2.0
+        assert out["waste_reason"] == "crash"
+        assert led.snapshot()["waste_by_reason"]["crash"] == 2.0
+
+    def test_note_retry_reclassifies_before_flush(self):
+        led = UsageLedger()
+        ru = RequestUsage()
+        ru.device_s = 1.0
+        led.finalize("r1", ru, tenant="t", priority="low", engine="e",
+                     ok=False)
+        led.note_retry("r1")
+        wb = led.snapshot()["waste_by_reason"]
+        assert wb.get("retry") == 1.0
+        assert wb.get("error", 0.0) == 0.0
+
+    def test_note_failover_parks_cause_when_announced_first(self):
+        led = UsageLedger()
+        led.note_failover("r1")            # router beats the engine
+        ru = RequestUsage()
+        ru.device_s = 0.7
+        out = led.finalize("r1", ru, tenant="t", priority="high",
+                           engine="e", ok=False)
+        assert out["waste_reason"] == "failover"
+        assert led.snapshot()["waste_by_reason"]["failover"] == \
+            pytest.approx(0.7)
+
+    def test_specific_reasons_are_not_rewritable(self):
+        led = UsageLedger()
+        ru = RequestUsage()
+        ru.device_s = 1.0
+        led.finalize("r1", ru, tenant="t", priority="low", engine="e",
+                     ok=False, waste_reason="crash")
+        led.note_retry("r1")
+        assert led.snapshot()["waste_by_reason"] == {"crash": 1.0}
+
+    def test_tenant_label_bounds_and_id_spray_collapse(self):
+        led = UsageLedger(max_tenants=3)
+        assert led.tenant_label("alpha") == "alpha"
+        assert led.tenant_label("beta") == "beta"
+        assert led.tenant_label("gamma") == "gamma"
+        assert led.tenant_label("delta") == "other"     # over the bound
+        assert led.tenant_label("alpha") == "alpha"     # registered stays
+        # id-shaped tenants never become labels, even under the bound.
+        led2 = UsageLedger(max_tenants=100)
+        assert led2.tenant_label(
+            "8c94e42e-6f3f-4a73-a18f-000000000001") == "other"
+        assert led2.tenant_label("1234567890") == "other"
+
+    def test_sanitize_tenant(self):
+        assert sanitize_tenant("") == "default"
+        assert sanitize_tenant(None) == "default"
+        assert sanitize_tenant("  team-a  ") == "team-a"
+        assert len(sanitize_tenant("x" * 500)) == 64
+
+    def test_conversation_rollup_is_lru_bounded(self):
+        led = UsageLedger(max_conversations=2)
+        for i in range(4):
+            ru = RequestUsage()
+            ru.device_s = 0.1
+            led.finalize(f"r{i}", ru, tenant="t", priority="low",
+                         engine="e", conversation=f"c{i}", ok=True)
+        convs = led.snapshot()["conversations"]
+        assert set(convs) == {"c2", "c3"}
+
+    def test_disabled_ledger_records_nothing_via_notes(self):
+        led = UsageLedger(enabled=False)
+        led.note_retry("r1")
+        led.note_failover("r2")
+        led.pin_kv("c", 5, "t")
+        led.unpin_kv("c")
+        assert led.snapshot()["waste_by_reason"] == {}
+        assert led.pinned_kv_page_s == 0.0
+
+
+# -- engine attribution: conservation invariant --------------------------------
+
+
+class TestEchoConservation:
+    def test_attribution_conserves_measured_device_time(self):
+        led = get_usage_ledger()
+        eng = make_echo_engine("usage-c1")
+        hs = [eng.submit(GenRequest(
+                  id=f"c{i}", prompt=f"conservation prompt {i} " * (i + 1),
+                  priority=Priority.NORMAL, max_new_tokens=16,
+                  tenant_id=f"tenant-{i % 3}"))
+              for i in range(12)]
+        eng.run_until_idle()
+        assert all(h.result.finish_reason in ("eos", "length")
+                   for h in hs)
+        measured, accounted = _conservation([eng], led)
+        assert measured > 0
+        assert accounted == pytest.approx(measured, rel=0.01)
+        # Finalized records sum to the attributed part.
+        snap = led.snapshot()
+        t = snap["totals"]
+        finalized = (t["useful_device_seconds"]
+                     + t["waste_device_seconds"])
+        assert finalized == pytest.approx(led.attributed_device_s,
+                                          rel=0.01)
+        assert snap["tenants"].keys() == {
+            "tenant-0", "tenant-1", "tenant-2"}
+
+    def test_conservation_with_chaos_crash_and_cancel(self):
+        """Chaos-shaped traffic: a mid-flight engine crash recovery and
+        client cancellations — the wasted device time lands in
+        usage_waste_seconds (crash / cancelled), not silently dropped,
+        and the invariant still holds."""
+        led = get_usage_ledger()
+        eng = make_echo_engine("usage-c2")
+        hs = [eng.submit(GenRequest(
+                  id=f"x{i}", prompt="chaos conservation " * 4,
+                  priority=Priority.NORMAL, max_new_tokens=32))
+              for i in range(6)]
+        for _ in range(8):                 # partial progress
+            eng.step()
+        hs[0].cancel()                     # client gave up
+        eng.step()
+        eng.step()
+        # Crash recovery: every in-flight handle fails over with its
+        # accumulated device time classified as crash waste.
+        out = eng.recover_after_crash()
+        assert out["recovered"] > 0
+        measured, accounted = _conservation([eng], led)
+        assert measured > 0
+        assert accounted == pytest.approx(measured, rel=0.01)
+        wb = led.snapshot()["waste_by_reason"]
+        assert wb.get("crash", 0.0) > 0.0
+        assert sum(wb.values()) > 0.0
+
+    def test_retry_waste_reaches_the_metric_counter(self):
+        """The worker's retry decision relabels the failed attempt's
+        waste; after a flush the prometheus counter carries it."""
+        led = get_usage_ledger()
+        eng = make_echo_engine("usage-c3")
+        h = eng.submit(GenRequest(id="retry-1",
+                                  prompt="will be cancelled " * 8,
+                                  max_new_tokens=48))
+        for _ in range(6):
+            eng.step()
+        h.cancel()                         # worker-timeout path shape
+        eng.run_until_idle()
+        led.note_retry("retry-1")          # worker schedules the retry
+        assert led.snapshot()["waste_by_reason"].get("retry", 0) > 0
+        from llmq_tpu.metrics.registry import REGISTRY
+        before = REGISTRY.get_sample_value(
+            "llm_queue_usage_waste_seconds_total",
+            {"reason": "retry"}) or 0.0
+        led.metrics_enabled = True
+        led.flush()
+        after = REGISTRY.get_sample_value(
+            "llm_queue_usage_waste_seconds_total", {"reason": "retry"})
+        assert after is not None and after > before
+
+    def test_preempt_shed_waste_attributed(self):
+        """A low-tier sequence is slot-preempted by a realtime arrival,
+        then loses its parked pages to pool pressure ("shed"); its
+        rebuild re-prefill — run through mixed iterations while the
+        other rows decode — is billed as shed waste, while the request
+        still completes and keeps its useful time."""
+        from llmq_tpu.core.config import MixedBatchConfig
+        led = get_usage_ledger()
+        tok = ByteTokenizer()
+        ex = EchoExecutor(batch_size=2, page_size=8, num_pages=14,
+                          max_pages_per_seq=16, eos_id=tok.eos_id,
+                          chunk_size=4, mixed_prefill_slices=2,
+                          mixed_slice_tokens=8)
+        eng = InferenceEngine(
+            ex, tok, name="usage-shed", enable_metrics=False,
+            max_decode_steps=64,
+            mixed_batch=MixedBatchConfig(enabled=True,
+                                         prefill_token_budget=16,
+                                         max_slices=2))
+        x = eng.submit(GenRequest(id="x", prompt="x" * 32,
+                                  priority=Priority.NORMAL,
+                                  max_new_tokens=32))
+        low = eng.submit(GenRequest(id="low", prompt="y" * 16,
+                                    priority=Priority.LOW,
+                                    max_new_tokens=16))
+        for _ in range(4):
+            eng.step()
+        rt = eng.submit(GenRequest(id="rt", prompt="z" * 16,
+                                   priority=Priority.REALTIME,
+                                   max_new_tokens=16))
+        eng.run_until_idle()
+        for h in (x, low, rt):
+            assert h.result.finish_reason in ("eos", "length")
+        measured, accounted = _conservation([eng], led)
+        assert accounted == pytest.approx(measured, rel=0.01)
+        wb = led.snapshot()["waste_by_reason"]
+        assert (wb.get("preempt", 0.0) + wb.get("shed", 0.0)) > 0.0
+        # The shed request still delivered output: its useful time
+        # survives next to its waste.
+        rec = led.get("low")
+        assert rec is not None and rec["device_seconds"] > 0
+        assert rec["waste_seconds"] > 0
+
+
+class TestJaxConservation:
+    def test_attribution_conserves_on_cpu_jax_engine(self):
+        """The invariant on the real executor: measured step_device_ms
+        vs attributed+unattributed, within 1 %, chaos included (a
+        cancellation mid-decode)."""
+        import jax
+
+        from llmq_tpu.engine.executor import JaxExecutor
+        from llmq_tpu.models.llama import get_config, init_params
+        led = get_usage_ledger()
+        cfg = get_config("llama3-tiny", max_seq_len=256, vocab_size=512)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tok = ByteTokenizer()
+        ex = JaxExecutor(cfg, params, batch_size=3, page_size=8,
+                         num_pages=96, prefill_buckets=[16, 64],
+                         eos_id=tok.eos_id, chunk_size=4)
+        eng = InferenceEngine(ex, tok, name="usage-jax",
+                              enable_metrics=False, max_decode_steps=12)
+        hs = [eng.submit(GenRequest(
+                  id=f"j{i}", prompt=f"jax conservation {i}",
+                  priority=Priority.NORMAL, max_new_tokens=10,
+                  tenant_id="jax-tenant"))
+              for i in range(4)]
+        for _ in range(3):
+            eng.step()
+        hs[0].cancel()                     # chaos: client went away
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        measured, accounted = _conservation([eng], led)
+        assert measured > 0
+        assert accounted == pytest.approx(measured, rel=0.01)
+        snap = led.snapshot()
+        assert snap["tenants"]["jax-tenant"]["requests"] == 4
+
+
+class TestKvPageSeconds:
+    def test_kv_page_seconds_scale_with_holding_time(self):
+        led = get_usage_ledger()
+        eng = make_echo_engine("usage-kv", chunk=1)
+        h = eng.submit(GenRequest(id="kv1", prompt="hold pages " * 6,
+                                  max_new_tokens=8))
+        # Drip-feed steps so the pages are held across real wall time.
+        for _ in range(40):
+            eng.step()
+            if h.done:
+                break
+            time.sleep(0.002)
+        eng.run_until_idle()
+        rec = led.get("kv1")
+        assert rec is not None
+        assert rec["kv_page_seconds"] > 0
+
+    def test_pinned_conversation_kv_billed_to_tenant(self):
+        led = get_usage_ledger()
+        eng = make_echo_engine("usage-pin")
+        h = eng.submit(GenRequest(id="p1", prompt="turn one " * 4,
+                                  conversation_id="conv-pin",
+                                  max_new_tokens=6,
+                                  tenant_id="pinned-tenant"))
+        eng.run_until_idle()
+        assert h.result.finish_reason in ("eos", "length")
+        time.sleep(0.05)                   # pinned residency window
+        eng.drop_conversation("conv-pin")  # TTL/eviction shape
+        assert led.pinned_kv_page_s > 0
+        snap = led.snapshot()
+        assert snap["tenants"]["pinned-tenant"]["kv_page_seconds"] > 0
+
+
+# -- goodput -------------------------------------------------------------------
+
+
+class TestGoodput:
+    def test_goodput_joins_slo_verdict_with_device_time(self):
+        led = get_usage_ledger()
+        rec = get_recorder()
+        rec.clear()
+        eng = make_echo_engine("usage-gp")
+        hs = [eng.submit(GenRequest(
+                  id=f"g{i}", prompt="goodput join " * 3,
+                  priority=Priority.NORMAL, max_new_tokens=8))
+              for i in range(5)]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        rec.flush_metrics()                # drives the join
+        gp = led.goodput()
+        assert gp["requests"] == 5
+        assert gp["slo_met_requests"] == 5
+        assert gp["tokens_slo_met"] > 0
+        assert gp["tokens_per_device_second"] > 0
+
+    def test_failed_requests_drag_goodput_down(self):
+        led = get_usage_ledger()
+        rec = get_recorder()
+        rec.clear()
+        eng = make_echo_engine("usage-gp2")
+        h = eng.submit(GenRequest(id="gbad", prompt="doomed " * 6,
+                                  max_new_tokens=32))
+        for _ in range(6):
+            eng.step()
+        h.cancel()
+        eng.run_until_idle()
+        rec.flush_metrics()
+        gp = led.goodput()
+        assert gp["requests"] == 1
+        assert gp["slo_met_requests"] == 0
+        assert gp["device_seconds"] > 0          # waste in denominator
+        assert gp["tokens_per_device_second"] == 0.0
+
+
+# -- surfaces: handle / worker metadata / trace / API --------------------------
+
+
+class TestSurfaces:
+    def test_finished_handle_carries_usage(self):
+        eng = make_echo_engine("usage-s1")
+        h = eng.submit(GenRequest(id="s1", prompt="surface " * 3,
+                                  max_new_tokens=6, tenant_id="acme"))
+        eng.run_until_idle()
+        assert h.usage is not None
+        assert h.usage["tenant"] == "acme"
+        assert h.usage["device_seconds"] > 0
+
+    def test_process_fn_merges_usage_into_message_metadata(self):
+        eng = make_echo_engine("usage-s2")
+        eng.start()
+        try:
+            msg = Message(id="s2", content="worker seam " * 3,
+                          tenant_id="acme")
+            msg.metadata["max_new_tokens"] = 6
+            eng.process_fn(None, msg)
+        finally:
+            eng.stop()
+        u = msg.metadata["usage"]
+        assert u["completion_tokens"] > 0          # pre-existing keys
+        assert u["device_seconds"] > 0             # attribution keys
+        assert u["tenant"] == "acme"
+
+    def test_trace_summary_shows_cost_next_to_latency(self):
+        rec = get_recorder()
+        rec.clear()
+        eng = make_echo_engine("usage-s3")
+        h = eng.submit(GenRequest(id="s3-trace", prompt="cost " * 4,
+                                  max_new_tokens=6))
+        eng.run_until_idle()
+        assert h.done
+        tl = rec.get("s3-trace")
+        assert tl is not None
+        summ = tl.summary()
+        assert summ["tokens"]["completion"] > 0
+        assert summ["usage"]["device_seconds"] > 0
+        full = tl.to_dict()
+        assert full["usage"]["device_seconds"] > 0
+        assert full["tokens"]["prompt"] > 0
+
+    def test_usage_api_route_and_tenant_header(self):
+        from llmq_tpu.api.server import ApiServer
+        from llmq_tpu.core.config import default_config
+        eng = make_echo_engine("usage-s4")
+        eng.start()
+        api = ApiServer(default_config(), engine=eng)
+        try:
+            import json
+            status, payload, _ = api.dispatch(
+                "POST", "/api/v1/messages",
+                json.dumps({"id": "s4-hdr", "content": "via header",
+                            "stream": True,
+                            "max_new_tokens": 4}).encode(),
+                headers={"X-Tenant-Id": "header-tenant"})
+            assert status == 200
+            events = list(payload)         # drain the SSE stream
+            done = [e for e in events if e.startswith("event: done")]
+            assert done, events
+            body = json.loads(done[0].split("data: ", 1)[1])
+            assert body["usage"]["tenant"] == "header-tenant"
+            assert body["usage"]["device_seconds"] >= 0
+            status, snap, _ = api.dispatch("GET", "/api/v1/usage", b"")
+            assert status == 200
+            assert "header-tenant" in snap["tenants"]
+            assert "goodput" in snap
+            status, one, _ = api.dispatch(
+                "GET", "/api/v1/usage?tenant=header-tenant", b"")
+            assert status == 200
+            assert one["usage"]["requests"] >= 1
+        finally:
+            eng.stop()
+
+    def test_engine_stats_route_carries_usage(self):
+        from llmq_tpu.api.server import ApiServer
+        from llmq_tpu.core.config import default_config
+        eng = make_echo_engine("usage-s5")
+        hs = [eng.submit(GenRequest(id=f"s5-{i}", prompt="stats",
+                                    max_new_tokens=4))
+              for i in range(2)]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        api = ApiServer(default_config(), engine=eng)
+        status, payload, _ = api.dispatch("GET", "/api/v1/engine/stats",
+                                          b"")
+        assert status == 200
+        assert payload["usage"]["totals"]["requests"] >= 2
+
+    def test_cluster_overview_aggregates_usage(self):
+        from llmq_tpu.cluster.router import ClusterRouter
+        from llmq_tpu.core.config import ClusterConfig
+        from llmq_tpu.loadbalancer.load_balancer import LoadBalancer
+        eng = make_echo_engine("usage-s6")
+        hs = [eng.submit(GenRequest(id=f"s6-{i}", prompt="overview",
+                                    max_new_tokens=4))
+              for i in range(3)]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        router = ClusterRouter(LoadBalancer(), config=ClusterConfig(),
+                               enable_metrics=False)
+        router.register_engine(eng)
+        out = router.overview()
+        agg = out["aggregate"]["usage"]
+        assert agg["reporting"] == 1
+        assert agg["device_seconds"] > 0
+        assert out["replicas"][0]["usage"]["totals"]["requests"] >= 3
+
+
+# -- hard off-switch -----------------------------------------------------------
+
+
+class TestOffSwitch:
+    def test_disabled_plane_records_nothing(self):
+        led = get_usage_ledger()
+        led.reconfigure(enabled=False)
+        eng = make_echo_engine("usage-off")
+        h = eng.submit(GenRequest(id="off1", prompt="dark " * 3,
+                                  max_new_tokens=6))
+        eng.run_until_idle()
+        assert h.result.finish_reason in ("eos", "length")
+        assert h.usage is None
+        assert led.total_device_s == 0.0
+        assert led.requests_finalized == 0
+        assert led.tracker.holders() == 0
+
+    def test_usage_route_503_when_disabled(self):
+        from llmq_tpu.api.server import ApiServer
+        from llmq_tpu.core.config import default_config
+        get_usage_ledger().reconfigure(enabled=False)
+        api = ApiServer(default_config())
+        status, payload, _ = api.dispatch("GET", "/api/v1/usage", b"")
+        assert status == 503
+
+    def test_config_wiring(self):
+        from llmq_tpu.core.config import default_config
+        from llmq_tpu.observability.recorder import configure
+        cfg = default_config()
+        cfg.observability.usage.enabled = False
+        cfg.observability.usage.max_tenants = 7
+        configure(cfg.observability)
+        led = get_usage_ledger()
+        assert led.enabled is False
+        assert led.max_tenants == 7
+        cfg.observability.usage.enabled = True
+        configure(cfg.observability)
+        assert led.enabled is True
+
+
+# -- overhead guard (the plane must stay off the step hot path) ----------------
+
+
+class TestOverheadGuard:
+    def test_charge_step_under_3pct_of_echo_request(self):
+        """Mirrors the PR-3/PR-6 guards: measure one echo request
+        end-to-end, then the per-chunk cost of the usage charge path
+        (_charge_step with a realistic part list), and require
+        chunks-per-request x per-call < 3 % of the request."""
+        eng = make_echo_engine("usage-oh", chunk=1)
+        n, max_new = 24, 16
+        t0 = time.perf_counter()
+        hs = [eng.submit(GenRequest(id=f"oh{i}", prompt="overhead " * 2,
+                                    max_new_tokens=max_new))
+              for i in range(n)]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        per_request = (time.perf_counter() - t0) / n
+        calls_per_request = (
+            eng.get_stats()["device"]["steps"]["count"] / n)
+
+        probe = make_echo_engine("usage-oh-probe")
+        seqs = []
+        for i in range(4):
+            h = probe.submit(GenRequest(id=f"p{i}", prompt="x",
+                                        max_new_tokens=4))
+            seqs.append(h)
+        with probe._mu:
+            rows = list(probe._inbox)
+        parts = [(s, 4, False) for s in rows]
+        per_call = float("inf")
+        for _ in range(5):
+            m = 2000
+            t0 = time.perf_counter()
+            for _ in range(m):
+                probe._charge_step(1e-4, parts)
+            per_call = min(per_call,
+                           (time.perf_counter() - t0) / m)
+        cost = calls_per_request * per_call
+        assert cost < 0.03 * per_request, (
+            f"usage charging {cost * 1e6:.1f}us/request "
+            f"({calls_per_request:.1f} chunks x {per_call * 1e6:.1f}us)"
+            f" vs request {per_request * 1e6:.1f}us")
+
+
+# -- tracker concurrency -------------------------------------------------------
+
+
+class TestTrackerConcurrency:
+    def test_concurrent_updates_and_closes_stay_consistent(self):
+        tr = PageUsageTracker()
+        stop = threading.Event()
+        errs = []
+
+        def churn(key):
+            try:
+                i = 0
+                while not stop.is_set():
+                    tr.update(key, i % 3, shared=(1, 2))
+                    i += 1
+                tr.close(key)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=churn, args=(f"k{i}",))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        time.sleep(0.15)
+        stop.set()
+        for t in ts:
+            t.join(timeout=5)
+        assert not errs
+        assert tr.holders() == 0
