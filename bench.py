@@ -10,6 +10,9 @@ processing with 0.5-3 s sleeps, cmd/queue-manager/main.go:139-153).
 Extra fields:
 - ``tiers``: per-priority-tier p50/p99 end-to-end latency under a 4-tier
   Poisson load against the echo engine (BASELINE config #1).
+- ``tenancy``: two-tenant 4:1-weight isolation against the echo engine
+  (docs/tenancy.md) — achieved token share under saturation and the
+  victim tenant's realtime p99 with and without an aggressor burst.
 - ``tpu``: single-chip decode tokens/s, per-step ms, prefill tokens/s
   (serialized + pipelined) and MFU with a real paged-KV Llama model
   (BASELINE config #2) when an accelerator is present.
@@ -35,7 +38,9 @@ int8 KV), LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU,
 LLMQ_BENCH_PREFIX_CACHE (=0 disables the radix prefix KV cache in the
 SLA sweeps for A/B comparison), LLMQ_BENCH_MIXED_BATCH (=0 disables
 token-budget mixed prefill+decode batching for A/B) /
-LLMQ_BENCH_MIXED_BUDGET / LLMQ_BENCH_MIXED_SLICES.
+LLMQ_BENCH_MIXED_BUDGET / LLMQ_BENCH_MIXED_SLICES,
+LLMQ_BENCH_TENANCY_RATE / LLMQ_BENCH_TENANCY_SECS (victim offered rate
+and per-phase duration for the tenancy isolation section).
 """
 
 from __future__ import annotations
@@ -262,6 +267,230 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
         log(f"[wire] echo wire measurement failed: "
             f"{type(e).__name__}: {e}")
     engine.stop()
+    return out
+
+
+# -- 2b. tenancy isolation (docs/tenancy.md) ----------------------------------
+
+def bench_tenancy_isolation(rate_per_s: float = 300.0,
+                            duration_s: float = 4.0,
+                            aggressor_inflight: int = 8) -> Dict:
+    """Two tenants at 4:1 weights through the echo engine with the
+    tenancy plane ON (weighted fair dequeue + shared registry).
+
+    Three phases:
+
+    1. **solo** — victim tenant ``b`` alone at a modest realtime rate →
+       baseline p99;
+    2. **burst** — aggressor ``a`` floods the SAME tier at 4× the
+       victim's rate (open loop, so a standing backlog forms) while
+       ``b`` keeps its solo rate → the victim's p99 must hold (the
+       ISSUE gate: < 10% over solo);
+    3. **share** — both tenants saturated (closed-loop drain of equal
+       pre-loaded backlogs) → served token share must converge to the
+       configured 4:1 (±15%).
+
+    Reports per-tenant achieved share vs configured weight, the
+    victim's p99 in both phases, and the aggressor-burst delta."""
+    from llmq_tpu import tenancy
+    from llmq_tpu.core.config import TenancyConfig
+    from llmq_tpu.engine import EchoExecutor, InferenceEngine, ByteTokenizer
+    from llmq_tpu.queueing.factory import QueueFactory, QueueType
+
+    cfg = default_config()
+    cfg.queue.worker.max_batch_size = 16
+    cfg.queue.worker.process_interval = 0.001
+    cfg.queue.worker.max_concurrent = 128
+    cfg.queue.enable_metrics = False
+    # WFQ reorders only what is still QUEUED — without an in-flight cap
+    # a saturating tenant's popped-but-unfinished work piles up at
+    # engine admission, ahead of every later victim arrival. Capping
+    # the aggressor's dispatched work at (engine slots - headroom)
+    # keeps the burst absorbed INSIDE the queue, where fairness holds.
+    cfg.tenancy = TenancyConfig(
+        enabled=True,
+        tenants={"a": {"weight": 4.0,
+                       "max_inflight": aggressor_inflight},
+                 "b": {"weight": 1.0}})
+
+    tok = ByteTokenizer()
+    # Short decode chunks: engine admission happens at chunk
+    # boundaries, so the chunk duration is the victim's floor on
+    # added latency while the aggressor keeps the engine busy.
+    executor = EchoExecutor(batch_size=64, page_size=16, num_pages=4096,
+                            max_pages_per_seq=16, eos_id=tok.eos_id,
+                            chunk_size=4)
+    engine = InferenceEngine(executor, tok, enable_metrics=False,
+                             max_decode_steps=16)
+    engine.start()
+
+    lat: Dict[str, List[float]] = {"a": [], "b": []}
+    lock = threading.Lock()
+    submit_t: Dict[str, float] = {}
+
+    def process(ctx, msg: Message) -> None:
+        engine.process_fn(ctx, msg)
+        now = time.perf_counter()
+        with lock:
+            t0 = submit_t.pop(msg.id, None)
+            if t0 is not None:
+                lat[msg.tenant_id].append(now - t0)
+
+    def mk(mid: str, tenant: str, prio: Priority) -> Message:
+        m = Message(id=mid, content=f"tenant {tenant} req", user_id="bench",
+                    priority=prio, timeout=30.0, tenant_id=tenant)
+        m.metadata["max_new_tokens"] = 8
+        return m
+
+    def open_loop(phase: str, offered: Dict[str, float],
+                  secs: float, manager) -> Dict[str, float]:
+        """Poisson arrivals per tenant for ``secs``; returns p99 (s)
+        per tenant once the VICTIM's submissions have completed (the
+        aggressor's standing backlog is left to drain — it is the
+        experiment, not part of the measurement)."""
+        with lock:
+            lat["a"].clear()
+            lat["b"].clear()
+            submit_t.clear()
+        rng = random.Random(7)
+        n_sent = 0
+        n_victim = 0
+        nxt = {t: time.perf_counter() for t in offered}
+        t_start = time.perf_counter()
+        while time.perf_counter() - t_start < secs:
+            now = time.perf_counter()
+            due = [t for t, at in nxt.items() if at <= now]
+            if not due:
+                time.sleep(0.0005)
+                continue
+            for t in due:
+                nxt[t] += rng.expovariate(offered[t])
+                mid = f"{phase}-{t}{n_sent}"
+                with lock:
+                    submit_t[mid] = time.perf_counter()
+                manager.push_message(mk(mid, t, Priority.REALTIME))
+                n_sent += 1
+                if t == "b":
+                    n_victim += 1
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline:
+            with lock:
+                if len(lat["b"]) >= n_victim:
+                    break
+            time.sleep(0.02)
+        with lock:
+            return {t: pctl(lat[t], 0.99) for t in ("a", "b")}
+
+    factories: List[QueueFactory] = []
+    try:
+        factory = QueueFactory(cfg)
+        factories.append(factory)
+        manager = factory.create_queue_manager("tenancy",
+                                               QueueType.STANDARD)
+        workers = factory.create_workers("tenancy", 4, process)
+        for w in workers:
+            w.start()
+
+        # Discarded warm phase: thread pools, engine dispatch paths and
+        # the allocator all reach steady state before anything counts.
+        open_loop("warm", {"b": rate_per_s}, min(1.0, duration_s),
+                  manager)
+        log(f"[tenancy] solo: victim b alone at {rate_per_s:.0f}/s "
+            f"for {duration_s:.0f}s ...")
+        solo = open_loop("solo", {"b": rate_per_s}, duration_s, manager)
+        log(f"[tenancy] burst: aggressor a at 4x "
+            f"({4 * rate_per_s:.0f}/s), b unchanged ...")
+        burst = open_loop("burst", {"a": 4 * rate_per_s,
+                                    "b": rate_per_s}, duration_s,
+                          manager)
+        factory.stop_all()
+
+        # Control: the SAME burst with tenancy OFF — plain FIFO within
+        # the tier puts every victim arrival behind the aggressor's
+        # standing backlog. This is the number the plane exists to fix.
+        tenancy.reset_tenancy()
+        cfg_off = default_config()
+        cfg_off.queue.worker.max_batch_size = 16
+        cfg_off.queue.worker.process_interval = 0.001
+        cfg_off.queue.worker.max_concurrent = 128
+        cfg_off.queue.enable_metrics = False
+        factory_off = QueueFactory(cfg_off)
+        factories.append(factory_off)
+        manager_off = factory_off.create_queue_manager(
+            "tenancy-off", QueueType.STANDARD)
+        workers_off = factory_off.create_workers("tenancy-off", 4,
+                                                 process)
+        for w in workers_off:
+            w.start()
+        log(f"[tenancy] control: same burst, tenancy OFF (FIFO) ...")
+        burst_off = open_loop("fifo", {"a": 4 * rate_per_s,
+                                       "b": rate_per_s}, duration_s,
+                              manager_off)
+        factory_off.stop_all()
+
+        # Phase 3 — share under saturation, on a FRESH manager (a new
+        # FairScheduler: the burst phase's earned virtual-time debt
+        # must not leak into the share measurement): closed-loop drain
+        # with both tenants backlogged for the WHOLE measured window
+        # (800 of each pre-loaded, 800 served, neither runs dry).
+        tenancy.reset_tenancy()
+        factory2 = QueueFactory(cfg)
+        factories.append(factory2)
+        manager2 = factory2.create_queue_manager("tenancy-share",
+                                                 QueueType.STANDARD)
+        n_each, n_serve = 800, 800
+        for i in range(n_each):
+            manager2.push_message(mk(f"sh-a{i}", "a", Priority.NORMAL))
+            manager2.push_message(mk(f"sh-b{i}", "b", Priority.NORMAL))
+        served = 0
+        while served < n_serve:
+            m = manager2.try_pop_message("normal")
+            if m is None:
+                break
+            engine.process_fn(None, m)
+            manager2.complete_message(m)
+            served += 1
+        snap = manager2.fair_snapshot() or {}
+        tokens = {t: snap.get("served_tokens", {}).get(t, 0)
+                  for t in ("a", "b")}
+        factory2.stop_all()
+    finally:
+        # stop_all is re-runnable; running it here (not just on the
+        # success path) means a phase that raises can't leak live
+        # worker threads into the later bench sections.
+        for f in factories:
+            f.stop_all()
+        engine.stop()
+        # The registry and scheduler set are process singletons — reset
+        # so later bench sections (and their default-tenant traffic)
+        # run with tenancy off, exactly as configured.
+        tenancy.reset_tenancy()
+
+    share = tokens["a"] / max(1, tokens["b"])
+    p99_solo_ms = round(solo["b"] * 1e3, 2)
+    p99_burst_ms = round(burst["b"] * 1e3, 2)
+    p99_fifo_ms = round(burst_off["b"] * 1e3, 2)
+    delta_pct = round(100.0 * (p99_burst_ms - p99_solo_ms)
+                      / max(1e-9, p99_solo_ms), 1)
+    isolation_x = round(p99_fifo_ms / max(1e-9, p99_burst_ms), 1)
+    out = {
+        "weights": {"a": 4.0, "b": 1.0},
+        "victim_rate_per_s": rate_per_s,
+        "aggressor_inflight_cap": aggressor_inflight,
+        "victim_p99_solo_ms": p99_solo_ms,
+        "victim_p99_under_burst_ms": p99_burst_ms,
+        "victim_p99_under_burst_fifo_ms": p99_fifo_ms,
+        "victim_p99_delta_pct": delta_pct,
+        "isolation_factor_vs_fifo": isolation_x,
+        "saturated_served_tokens": tokens,
+        "achieved_share_a_to_b": round(share, 2),
+        "share_target": 4.0,
+        "share_within_15pct": bool(4.0 * 0.85 <= share <= 4.0 * 1.15),
+    }
+    log(f"[tenancy] share a:b = {share:.2f} (target 4.0) | victim p99 "
+        f"{p99_solo_ms:.1f}ms solo → {p99_burst_ms:.1f}ms under burst "
+        f"({delta_pct:+.1f}%) vs {p99_fifo_ms:.1f}ms FIFO control "
+        f"({isolation_x:.0f}x isolation)")
     return out
 
 
@@ -1074,6 +1303,15 @@ def main() -> None:
 
     qres = bench_queue_throughput(n_msgs)
     tiers = bench_poisson_echo(rate, secs)
+    tenancy_res = None
+    try:
+        tenancy_res = bench_tenancy_isolation(
+            rate_per_s=float(os.environ.get("LLMQ_BENCH_TENANCY_RATE",
+                                            "300")),
+            duration_s=float(os.environ.get("LLMQ_BENCH_TENANCY_SECS",
+                                            "4")))
+    except Exception as e:  # noqa: BLE001
+        log(f"[tenancy] isolation bench failed: {type(e).__name__}: {e}")
     tpu = None
     tpu_tiers = None
     tpu_tiers_8b = None
@@ -1107,6 +1345,7 @@ def main() -> None:
         "vs_baseline": round(qres["msgs_per_s"] / BASELINE_THROUGHPUT, 3),
         "queue": qres,
         "tiers": tiers,
+        "tenancy": tenancy_res,
         "tpu": tpu,
         "tpu_tiers": tpu_tiers,
         "tpu_tiers_8b": tpu_tiers_8b,
@@ -1115,6 +1354,10 @@ def main() -> None:
         # (VERDICT r4 weak #7 — the queue figure fell off the record).
         "headline": {
             "queue_msgs_per_s": qres["msgs_per_s"],
+            "tenant_share_a_to_b":
+                (tenancy_res or {}).get("achieved_share_a_to_b"),
+            "tenant_victim_p99_delta_pct":
+                (tenancy_res or {}).get("victim_p99_delta_pct"),
             "decode_tokens_per_s": (tpu or {}).get("decode_tokens_per_s"),
             "max_rate_realtime_p99_ok":
                 (tpu_tiers or {}).get("max_rate_realtime_p99_ok"),

@@ -539,6 +539,11 @@ def _load(args) -> Config:
     # attribute check.
     from llmq_tpu import chaos
     chaos.configure(cfg.chaos)
+    # Tenancy plane (docs/tenancy.md): the shared registry (weights,
+    # quotas, in-flight counters) must be configured before the queue
+    # managers build their fair schedulers against it.
+    from llmq_tpu import tenancy
+    tenancy.configure_tenancy(cfg.tenancy)
     _maybe_join_cluster()
     return cfg
 

@@ -32,30 +32,35 @@ import threading
 from typing import Optional
 
 from llmq_tpu.core.types import Message
+from llmq_tpu.observability.usage import sanitize_tenant
+from llmq_tpu.tenancy.registry import (estimate_prompt_tokens,
+                                       estimate_tokens)
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("overload")
 
-#: Crude prompt-size estimate when only text is available (the
-#: tokenizer must not run on the admission hot path).
-_CHARS_PER_TOKEN = 4.0
-
 
 class OverloadShedder:
     def __init__(self, config, queue_config=None, *, engine=None,
-                 resource_scheduler=None,
+                 resource_scheduler=None, tenant_registry=None,
                  enable_metrics: bool = True) -> None:
         #: core.config.OverloadConfig (or same-shaped object).
         self.config = config
         self.engine = engine
         self.resource_scheduler = resource_scheduler
+        #: Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): when set
+        #: AND enabled, per-tenant token-rate buckets and queue-depth
+        #: caps are enforced here — the established shedding seam —
+        #: with ``reason="tenant_quota"`` 429s.
+        self.tenant_registry = tenant_registry
         limit = int(getattr(config, "queue_depth_limit", 0) or 0)
         if limit <= 0 and queue_config is not None:
             limit = int(0.9 * getattr(queue_config, "max_queue_size",
                                       10000))
         self.queue_depth_limit = limit
         self._mu = threading.Lock()
-        self.shed_counts = {"backlog": 0, "sla": 0, "engine_down": 0}
+        self.shed_counts = {"backlog": 0, "sla": 0, "engine_down": 0,
+                            "tenant_quota": 0}
         self._metrics = None
         if enable_metrics:
             try:
@@ -74,6 +79,10 @@ class OverloadShedder:
         stream-level gates)."""
         retry_base = max(0.5, float(getattr(self.config, "retry_after",
                                             1.0)))
+        # One estimate per request: the quota peek and the post-gate
+        # charge must see the same figure.
+        est_tokens = estimate_tokens(msg)
+        self._reject_over_quota(msg, est_tokens, retry_base)
         eng = self.engine
         if eng is not None and not getattr(eng, "running", True):
             self._shed("engine_down", 503, retry_base,
@@ -100,6 +109,51 @@ class OverloadShedder:
                     f"cannot meet deadline: estimated {eta:.1f}s to "
                     f"first service exceeds the request's "
                     f"{msg.timeout:.1f}s budget")
+        self._charge_tenant(msg, est_tokens)
+
+    def _reject_over_quota(self, msg: Message, est_tokens: int,
+                           retry_base: float) -> None:
+        """Per-tenant quota gate (docs/tenancy.md), cheapest check
+        first: queue-depth cap, then the token-rate burst bucket
+        (PEEKED, not consumed — the bucket is charged only after every
+        global check passes, so a request the backlog/SLA checks shed
+        anyway never drains its tenant's rate quota). Runs BEFORE the
+        global checks so a quota-violating tenant gets its OWN 429
+        (with a bucket-derived Retry-After) instead of being folded
+        into a global backlog shed it also caused."""
+        reg = self.tenant_registry
+        if reg is None or not getattr(reg, "enabled", False):
+            return
+        tenant = sanitize_tenant(getattr(msg, "tenant_id", ""))
+        # (same normalization FairScheduler keys the depth/in-flight
+        # counters with — the gate and the accounting must agree)
+        if reg.over_queue_depth(tenant):
+            reg.note_rejection("queue_depth")
+            self._shed(
+                "tenant_quota", 429, retry_base,
+                f"tenant {tenant!r} queue depth cap reached "
+                f"({reg.queue_depth(tenant)} pending >= "
+                f"{reg.spec_for(tenant).max_queue_depth})")
+        ok, retry_after = reg.admit_tokens(tenant, est_tokens,
+                                           consume=False)
+        if not ok:
+            reg.note_rejection("rate")
+            self._shed(
+                "tenant_quota", 429, max(retry_base, retry_after),
+                f"tenant {tenant!r} token-rate limit exceeded "
+                f"(sustained {reg.spec_for(tenant).token_rate:.0f} "
+                f"tok/s)")
+
+    def _charge_tenant(self, msg: Message, est_tokens: int) -> None:
+        """The request passed every gate: NOW consume its tokens from
+        the tenant's bucket (unconditionally — a concurrent admit may
+        have drained the bucket since the peek; the admitted request is
+        real work, so it is charged as debt rather than re-rejected)."""
+        reg = self.tenant_registry
+        if reg is None or not getattr(reg, "enabled", False):
+            return
+        tenant = sanitize_tenant(getattr(msg, "tenant_id", ""))
+        reg.admit_tokens(tenant, est_tokens, consume=True, force=True)
 
     def _prefill_eta_s(self, msg: Message) -> float:
         """Learned prefill cost for this prompt (seconds); 0 until the
@@ -107,7 +161,7 @@ class OverloadShedder:
         rs = self.resource_scheduler
         if rs is None:
             return 0.0
-        est_tokens = int(len(msg.content or "") / _CHARS_PER_TOKEN)
+        est_tokens = estimate_prompt_tokens(msg)
         if est_tokens <= 0:
             return 0.0
         try:
@@ -142,10 +196,34 @@ def build_shedder(config, *, engine=None,
     ``core.config.Config``, or None when ``overload.enabled`` is false
     (the hard off-switch — no admission checks exist at all)."""
     ocfg = getattr(config, "overload", None)
-    if ocfg is None or not getattr(ocfg, "enabled", False):
+    overload_on = ocfg is not None and getattr(ocfg, "enabled", False)
+    tcfg = getattr(config, "tenancy", None)
+    tenancy_on = tcfg is not None and getattr(tcfg, "enabled", False)
+    if not overload_on and not tenancy_on:
         return None
+    tenant_registry = None
+    if tenancy_on:
+        # Quota enforcement rides the shedding seam (docs/tenancy.md);
+        # the SAME process singleton the queue manager's fair dequeue
+        # feeds, so depth counts here reflect the live fair index.
+        from llmq_tpu.tenancy import configure_tenancy
+        tenant_registry = configure_tenancy(tcfg)
+    if not overload_on:
+        # Tenant quotas must not silently vanish because GLOBAL
+        # shedding is off: build the shedder with every global check
+        # neutralized (no backlog limit, no deadline headroom, no
+        # engine gate) so only the tenant gate runs.
+        from llmq_tpu.core.config import OverloadConfig
+        neutral = OverloadConfig(enabled=False, queue_depth_limit=0,
+                                 deadline_headroom=0.0)
+        return OverloadShedder(
+            neutral, None, engine=None, resource_scheduler=None,
+            tenant_registry=tenant_registry,
+            enable_metrics=getattr(getattr(config, "queue", None),
+                                   "enable_metrics", True))
     return OverloadShedder(
         ocfg, getattr(config, "queue", None), engine=engine,
         resource_scheduler=resource_scheduler,
+        tenant_registry=tenant_registry,
         enable_metrics=getattr(getattr(config, "queue", None),
                                "enable_metrics", True))

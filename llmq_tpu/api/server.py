@@ -251,6 +251,7 @@ class ApiServer:
         r("GET", f"{v1}/cluster/overview", self.get_cluster_overview)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
         r("GET", f"{v1}/usage", self.get_usage)
+        r("GET", f"{v1}/tenancy", self.get_tenancy)
         r("POST", f"{v1}/generate", self.generate_sync)
         r("GET", f"{v1}/requests/:id/trace", self.get_request_trace)
         adm = f"{v1}/admin"
@@ -937,6 +938,28 @@ class ApiServer:
                 "goodput": snap["goodput"],
             }
         return 200, snap
+
+    def get_tenancy(self, req: _Request) -> Tuple[int, Any]:
+        """Tenancy-plane state (docs/tenancy.md): configured classes,
+        live queue-depth/in-flight counters, quota-rejection totals,
+        and — per manager — the fair dequeue's virtual times, served
+        tokens and achieved-share ratios."""
+        from llmq_tpu.tenancy import get_tenant_registry
+        reg = get_tenant_registry()
+        if not reg.enabled:
+            raise ApiError(503, "tenancy plane disabled "
+                                "(set tenancy.enabled)")
+        out: Dict[str, Any] = reg.snapshot()
+        if self.factory is not None:
+            fair = {}
+            for name in self.factory.manager_names():
+                mgr = self.factory.get_queue_manager(name)
+                snap = (mgr.fair_snapshot()
+                        if mgr is not None else None)
+                if snap is not None:
+                    fair[name] = snap
+            out["fair"] = fair
+        return 200, out
 
     def get_cluster_overview(self, req: _Request) -> Tuple[int, Any]:
         """Cluster-wide device-telemetry rollup: per-replica MFU, tok/s,

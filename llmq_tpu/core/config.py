@@ -371,6 +371,56 @@ class ChaosConfig:
 
 
 @dataclass
+class TenantClassConfig:
+    """One tenant class: fairness weight + quota envelope
+    (llmq_tpu/tenancy/, docs/tenancy.md). Used both for named entries
+    under ``tenancy.tenants`` and as the default class every unlisted
+    tenant falls into."""
+    #: Weighted-fair-queueing weight: under contention a tenant's token
+    #: share within each priority level converges to
+    #: ``weight / sum(weights of active tenants)``.
+    weight: float = 1.0
+    #: Sustained token admission rate (prompt + expected completion
+    #: tokens per second) enforced at the API edge; <= 0 → unlimited.
+    token_rate: float = 0.0
+    #: Token-bucket burst capacity; <= 0 → one second of ``token_rate``
+    #: (no extra burst headroom beyond the sustained rate).
+    burst_tokens: float = 0.0
+    #: Concurrent dispatched (popped, unfinished) messages; <= 0 →
+    #: unlimited. Enforced at worker dispatch: the fair dequeue defers a
+    #: capped tenant's queued work rather than rejecting it.
+    max_inflight: int = 0
+    #: Queued (pending) messages across the manager's tier queues;
+    #: <= 0 → unlimited. Exceeding it is a 429 at the overload seam.
+    max_queue_depth: int = 0
+
+    def __post_init__(self) -> None:
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenancy weight must be > 0 (got {self.weight})")
+
+
+@dataclass
+class TenancyConfig:
+    """Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): weighted
+    fair dequeue, per-tenant quotas and burst isolation over
+    ``Message.tenant_id``. ``enabled: false`` (the DEFAULT) is a hard
+    off-switch: no fair scheduler or registry state exists and the
+    dequeue path is byte-identical to FIFO-within-priority."""
+    enabled: bool = False
+    #: Named tenant classes: tenant id → TenantClassConfig fields
+    #: (weight, token_rate, burst_tokens, max_inflight,
+    #: max_queue_depth). Unlisted tenants use ``default``.
+    tenants: Dict[str, Any] = field(default_factory=dict)
+    #: The class every tenant NOT listed in ``tenants`` belongs to.
+    default: TenantClassConfig = field(
+        default_factory=TenantClassConfig)
+    #: Rolling window (seconds) for the achieved-share gauge
+    #: (``tenant_share_ratio``).
+    share_window_s: float = 60.0
+
+
+@dataclass
 class OverloadConfig:
     """Adaptive overload shedding at the API layer (api/overload.py,
     docs/robustness.md): reject work the system cannot serve within
@@ -548,6 +598,7 @@ class Config:
         default_factory=ObservabilityConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     executor: ExecutorConfig = field(default_factory=ExecutorConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)

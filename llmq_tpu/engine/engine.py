@@ -59,6 +59,7 @@ from llmq_tpu.observability.device import get_device_telemetry
 from llmq_tpu.observability.usage import (DEFAULT_TENANT, RequestUsage,
                                           get_usage_ledger,
                                           sanitize_tenant)
+from llmq_tpu.tenancy import get_tenant_registry, weighted_token_caps
 from llmq_tpu.utils.logging import get_logger
 from llmq_tpu.utils.profiling import SpanRecorder
 
@@ -75,6 +76,50 @@ def _prefetch(arr) -> None:
         arr.copy_to_host_async()
     except (AttributeError, RuntimeError):
         pass
+
+
+def _pack_prefill_slices(cands, S, T, budget, tenant_caps):
+    """Pack prefill candidates (most urgent first) into ≤S slices of
+    ≤T tokens each, ≤budget total. With ``tenant_caps`` (multi-tenant
+    contention, docs/tenancy.md) pass 1 packs each tenant only up to
+    its weight-proportional share; pass 2 hands any leftover out in
+    plain urgency order, including WIDENING a slice pass 1 truncated
+    at its tenant's cap — so caps bind exactly when the budget is
+    genuinely contended and unclaimed share is never stranded
+    (work-conserving). Returns ``[(seq, token_ids)]``."""
+    pf_plan = []
+    plan_idx: Dict[int, int] = {}    # seq.order → index into pf_plan
+    packed = 0
+    packed_by_tenant: Dict[str, int] = {}
+    passes = (True, False) if tenant_caps is not None else (False,)
+    for capped in passes:
+        for seq in cands:
+            if packed >= budget:
+                break
+            idx = plan_idx.get(seq.order)
+            if idx is None and len(pf_plan) >= S:
+                continue             # no slice slots left; widen only
+            have = len(pf_plan[idx][1]) if idx is not None else 0
+            width = min(T - have, budget - packed)
+            tid = seq.req.tenant_id
+            if capped:
+                width = min(width,
+                            tenant_caps.get(tid, budget)
+                            - packed_by_tenant.get(tid, 0))
+            if width <= 0:
+                continue
+            sl = seq.todo_ids[:have + width]
+            added = len(sl) - have   # todo may be shorter than width
+            if added <= 0:
+                continue
+            if idx is None:
+                plan_idx[seq.order] = len(pf_plan)
+                pf_plan.append((seq, sl))
+            else:
+                pf_plan[idx] = (seq, sl)
+            packed += added
+            packed_by_tenant[tid] = packed_by_tenant.get(tid, 0) + added
+    return pf_plan
 
 
 @dataclass
@@ -373,6 +418,13 @@ class InferenceEngine:
         #: with ``observability.usage.enabled`` false every charge
         #: point below reduces to one attribute check.
         self._usage = get_usage_ledger()
+        #: Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): decode
+        #: fairness past the queue — under multi-tenant contention the
+        #: chunk's decode-row token budget and the mixed batcher's
+        #: prefill-token budget are capped at weight-proportional
+        #: shares. Disabled (the default), each fused-step check is one
+        #: attribute read.
+        self._tenancy = get_tenant_registry()
 
         self.allocator = PageAllocator(self.spec.num_pages,
                                        self.spec.page_size)
@@ -2018,7 +2070,44 @@ class InferenceEngine:
                     self._preempt(seq, release_pages=True)
                 continue
             budgets_by_order[seq.order] = budget
+        if self._tenancy.enabled:
+            self._apply_decode_fairness(rows, budgets_by_order)
         return budgets_by_order
+
+    def _apply_decode_fairness(self, rows, budgets_by_order) -> None:
+        """Tenancy plane, engine level (docs/tenancy.md): when rows
+        from MORE THAN ONE tenant share a chunk, cap each tenant's
+        slice of the chunk's total decode-token budget at its
+        weight-proportional share — so queue-level fairness holds past
+        admission into the fused step. Uncontended (single tenant, or
+        everyone under their share) the caps never bind and the chunk
+        is byte-identical to the unfair one. A row's budget never drops
+        below 1 (a zero budget would latch the row); budgets shrunk
+        here only delay tokens to the next chunk — pages were already
+        ensured for the larger budget, so no allocation is retracted.
+        """
+        by_tenant: Dict[str, List[_Sequence]] = {}
+        for seq in rows:
+            if seq.slot is not None and seq.order in budgets_by_order:
+                by_tenant.setdefault(seq.req.tenant_id, []).append(seq)
+        if len(by_tenant) < 2:
+            return   # free when uncontended
+        total = sum(budgets_by_order[s.order]
+                    for ss in by_tenant.values() for s in ss)
+        if total <= 0:
+            return
+        caps = weighted_token_caps(
+            {t: self._tenancy.weight_for(t) for t in by_tenant}, total)
+        for tenant, seqs in by_tenant.items():
+            t_sum = sum(budgets_by_order[s.order] for s in seqs)
+            cap = caps.get(tenant, t_sum)
+            if t_sum <= cap:
+                continue
+            scale = cap / t_sum
+            for s in seqs:
+                b = budgets_by_order[s.order]
+                if b > 1:
+                    budgets_by_order[s.order] = max(1, int(b * scale))
 
     def _decode_once(self) -> bool:
         B = self.spec.batch_size
@@ -2169,15 +2258,20 @@ class InferenceEngine:
                 self._finish_active(s, "cancelled")
                 cands.remove(s)
         cands.sort(key=lambda s: s.sort_key())
-        pf_plan = []                 # (seq, slice tokens)
-        packed = 0
-        for seq in cands[:S]:
-            width = min(T, budget - packed)
-            if width <= 0:
-                break
-            sl = seq.todo_ids[:width]
-            pf_plan.append((seq, sl))
-            packed += len(sl)
+        # Tenancy plane (docs/tenancy.md): under multi-tenant
+        # contention for the prefill budget, pack with per-tenant
+        # weight-proportional caps; with tenancy off (or one tenant)
+        # the single uncapped pass packs identically to the
+        # pre-tenancy loop.
+        tenant_caps = None
+        if self._tenancy.enabled:
+            cand_tenants = {s.req.tenant_id for s in cands}
+            if len(cand_tenants) > 1:
+                tenant_caps = weighted_token_caps(
+                    {t: self._tenancy.weight_for(t)
+                     for t in cand_tenants}, budget)
+        pf_plan = _pack_prefill_slices(cands, S, T, budget, tenant_caps)
+        packed = sum(len(sl) for _, sl in pf_plan)
         if not pf_plan:
             # Every candidate was shed/cancelled DURING decode
             # budgeting (a page-pressure race — _mixed_applicable

@@ -83,7 +83,14 @@ LABEL_CONTRACT = {
                          # usage-plane waste decomposition
                          # (observability/usage.py WASTE_REASONS):
                          "retry", "crash", "preempt", "shed",
-                         "cancelled", "error"}),
+                         "cancelled", "error",
+                         # tenancy plane (llmq_tpu/tenancy/):
+                         # "tenant_quota" on requests_shed_total;
+                         # rate/queue_depth/inflight on
+                         # tenant_quota_rejections_total
+                         # (tenancy.registry.QUOTA_REASONS).
+                         "tenant_quota", "rate", "queue_depth",
+                         "inflight"}),
     "path": frozenset({"mixed", "program"}),
     "point": None,      # compiled-in chaos fault points (fnmatch keys)
     "kind": frozenset({"error", "timeout", "partial", "oserror",
@@ -393,6 +400,30 @@ class QueueMetrics:
             f"{ns}_usage_tenants_tracked",
             "Distinct tenants with usage rollups this process",
             registry=registry)
+        # Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): fairness
+        # and quota visibility. ``tenant`` shares the usage ledger's
+        # first-come max_tenants bound (overflow/id-shaped → "other");
+        # gauges refresh at scrape time via tenancy.flush_metrics.
+        self.tenant_virtual_time = Gauge(
+            f"{ns}_tenant_virtual_time",
+            "Weighted-fair-queueing virtual time per tenant (tokens / "
+            "weight served; higher = further over its share)",
+            ["tenant"], registry=registry)
+        self.tenant_share_ratio = Gauge(
+            f"{ns}_tenant_share_ratio",
+            "Achieved token share / configured weight share over the "
+            "tenancy.share_window_s rolling window (1.0 = exactly the "
+            "configured share)", ["tenant"], registry=registry)
+        self.tenant_quota_rejections = Counter(
+            f"{ns}_tenant_quota_rejections_total",
+            "Per-tenant quota enforcement events: rate and queue_depth "
+            "are admission 429s, inflight counts dispatch-time "
+            "deferrals by the in-flight cap", ["reason"],
+            registry=registry)
+        self.tenant_inflight = Gauge(
+            f"{ns}_tenant_inflight",
+            "Dispatched (popped, unfinished) messages per tenant",
+            ["tenant"], registry=registry)
         # SLO layer (llmq_tpu/observability/slo.py): burn rate 1.0 =
         # spending exactly the allowed error budget over the window.
         self.slo_burn_rate = Gauge(
@@ -443,6 +474,14 @@ def exposition() -> bytes:
         # above fed the goodput join.
         from llmq_tpu.observability.usage import get_usage_ledger
         get_usage_ledger().flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Tenancy plane: buffered quota-rejection counts + per-tenant
+        # virtual-time / share-ratio / in-flight gauges (after the
+        # usage flush so the shared tenant-label bound is warm).
+        from llmq_tpu.tenancy import flush_metrics as tenancy_flush
+        tenancy_flush()
     except Exception:  # noqa: BLE001
         pass
     return generate_latest(REGISTRY)

@@ -79,6 +79,10 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.mlq_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
                                 ctypes.POINTER(ctypes.c_uint64),
                                 ctypes.POINTER(ctypes.c_double)]
+        lib.mlq_pop_handle.restype = ctypes.c_int64
+        lib.mlq_pop_handle.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_double,
+                                       ctypes.POINTER(ctypes.c_double)]
         lib.mlq_pop_if.restype = ctypes.c_int64
         lib.mlq_pop_if.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_uint64, ctypes.c_double]
@@ -149,6 +153,14 @@ class NativeMLQ:
         err = self._lib.mlq_pop(self._h, name.encode(), now,
                                 ctypes.byref(out_h), ctypes.byref(out_w))
         return err, out_h.value, out_w.value
+
+    def pop_handle(self, name: str, handle: int, now: float) -> Tuple[int, float]:
+        """Pop a SPECIFIC pending handle with full pop accounting (the
+        fair-dequeue layer's extraction op). Returns (err, wait)."""
+        out_w = ctypes.c_double(0.0)
+        err = self._lib.mlq_pop_handle(self._h, name.encode(), handle,
+                                       now, ctypes.byref(out_w))
+        return err, out_w.value
 
     def pop_if(self, name: str, expected_handle: int, now: float) -> int:
         """Atomic check-and-pop: pops only if the top is still

@@ -502,6 +502,11 @@ class FlightRecorder:
         with self._mu:
             self._ring.clear()
             self._slow.clear()
+            # Pending scrape-time observations go too — a stale tuple
+            # surviving clear() joins against a LATER test's usage
+            # ledger when request ids collide (seen: chaos crash test's
+            # "g0" inflating the goodput join count).
+            self._pending_metrics.clear()
             self.dropped = 0
             self.sla_breaches = 0
 

@@ -49,6 +49,12 @@ class _PyBackend:
 
     def __init__(self) -> None:
         self._heaps: Dict[str, List[Tuple[int, int, int, float]]] = {}
+        # Liveness index, handle → enqueue_ts. ``pop_handle``/``discard``
+        # remove items HERE in O(1) and leave the heap entry behind as a
+        # stale record (lazy deletion — mirrors mlq.cpp); pop/peek skip
+        # entries absent from this map as they surface. Handles are
+        # never reused, so membership alone decides liveness.
+        self._live: Dict[str, Dict[int, float]] = {}
         self._caps: Dict[str, int] = {}
         # [pend, proc, comp, fail, pops, wait, ptime] — pops counts the
         # wait samples feeding avg_wait (mirrors Stats in mlq.cpp).
@@ -61,6 +67,7 @@ class _PyBackend:
             if name in self._heaps:
                 return self.ERR_EXISTS
             self._heaps[name] = []
+            self._live[name] = {}
             self._caps[name] = capacity
             self._stats[name] = [0, 0, 0, 0, 0, 0.0, 0.0]
             return 0
@@ -70,6 +77,7 @@ class _PyBackend:
             if name not in self._heaps:
                 return self.ERR_NOT_FOUND
             del self._heaps[name], self._caps[name], self._stats[name]
+            del self._live[name]
             return 0
 
     def has_queue(self, name: str) -> bool:
@@ -81,10 +89,12 @@ class _PyBackend:
             heap = self._heaps.get(name)
             if heap is None:
                 return self.ERR_NOT_FOUND
+            live = self._live[name]
             cap = self._caps[name]
-            if cap > 0 and len(heap) >= cap:
+            if cap > 0 and len(live) >= cap:
                 return self.ERR_FULL
             heapq.heappush(heap, (priority, next(self._seq), handle, enqueue_ts))
+            live[handle] = enqueue_ts
             self._stats[name][0] += 1
             return 0
 
@@ -93,22 +103,57 @@ class _PyBackend:
             heap = self._heaps.get(name)
             if heap is None:
                 return self.ERR_NOT_FOUND, 0, 0.0
-            if not heap:
-                return self.ERR_EMPTY, 0, 0.0
-            _, _, handle, ts = heapq.heappop(heap)
+            live = self._live[name]
+            while heap:
+                _, _, handle, ts = heapq.heappop(heap)
+                if live.pop(handle, None) is None:
+                    continue   # stale: fair-popped/discarded earlier
+                wait = max(0.0, now - ts)
+                s = self._stats[name]
+                s[0] -= 1
+                s[1] += 1
+                s[4] += 1
+                s[5] += wait
+                return 0, handle, wait
+            return self.ERR_EMPTY, 0, 0.0
+
+    def pop_handle(self, name: str, handle: int,
+                   now: float) -> Tuple[int, float]:
+        """Pop a SPECIFIC pending handle with full pop accounting — the
+        fair-dequeue layer's extraction op (mirrors mlq_pop_handle in
+        mlq.cpp). O(1): drops the item from the liveness index and
+        leaves the heap entry to be skipped as stale when it surfaces.
+        Returns (err, wait)."""
+        with self._mu:
+            live = self._live.get(name)
+            if live is None:
+                return self.ERR_NOT_FOUND, 0.0
+            ts = live.pop(handle, None)
+            if ts is None:
+                return self.ERR_EMPTY, 0.0
             wait = max(0.0, now - ts)
             s = self._stats[name]
             s[0] -= 1
             s[1] += 1
             s[4] += 1
             s[5] += wait
-            return 0, handle, wait
+            # Fair pops never route through pop/peek, so reclaim stale
+            # heap entries here or the heap grows one per message forever.
+            self._drain_stale_locked(name)
+            return 0, wait
+
+    def _drain_stale_locked(self, name: str) -> None:
+        heap = self._heaps[name]
+        live = self._live[name]
+        while heap and heap[0][2] not in live:
+            heapq.heappop(heap)
 
     def peek(self, name: str) -> Tuple[int, int]:
         with self._mu:
             heap = self._heaps.get(name)
             if heap is None:
                 return self.ERR_NOT_FOUND, 0
+            self._drain_stale_locked(name)
             if not heap:
                 return self.ERR_EMPTY, 0
             return 0, heap[0][2]
@@ -118,11 +163,13 @@ class _PyBackend:
             heap = self._heaps.get(name)
             if heap is None:
                 return self.ERR_NOT_FOUND
+            self._drain_stale_locked(name)
             if not heap:
                 return self.ERR_EMPTY
             if heap[0][2] != expected_handle:
                 return -5  # mismatch: top changed under us
-            _, _, _, ts = heapq.heappop(heap)
+            _, _, handle, ts = heapq.heappop(heap)
+            self._live[name].pop(handle, None)
             s = self._stats[name]
             s[0] -= 1
             s[1] += 1
@@ -132,8 +179,8 @@ class _PyBackend:
 
     def size(self, name: str) -> int:
         with self._mu:
-            heap = self._heaps.get(name)
-            return self.ERR_NOT_FOUND if heap is None else len(heap)
+            live = self._live.get(name)
+            return self.ERR_NOT_FOUND if live is None else len(live)
 
     def complete(self, name: str, process_time: float) -> int:
         with self._mu:
@@ -168,19 +215,17 @@ class _PyBackend:
 
     def discard(self, name: str, handle: int) -> int:
         """Remove a pending item by handle with no wait/failed accounting
-        (admin deletion). Mirrors mlq_discard in mlq.cpp."""
+        (admin deletion). Mirrors mlq_discard in mlq.cpp. O(1) lazy
+        deletion like pop_handle."""
         with self._mu:
-            heap = self._heaps.get(name)
-            if heap is None:
+            live = self._live.get(name)
+            if live is None:
                 return self.ERR_NOT_FOUND
-            for i, item in enumerate(heap):
-                if item[2] == handle:
-                    heap[i] = heap[-1]
-                    heap.pop()
-                    heapq.heapify(heap)
-                    self._stats[name][0] -= 1
-                    return 0
-            return self.ERR_EMPTY
+            if live.pop(handle, None) is None:
+                return self.ERR_EMPTY
+            self._stats[name][0] -= 1
+            self._drain_stale_locked(name)
+            return 0
 
     def stats(self, name: str) -> Tuple[int, List[int], List[float]]:
         with self._mu:
@@ -227,6 +272,21 @@ class MultiLevelQueue:
         self._caps: Dict[str, int] = {}
         self._next_handle = itertools.count(1)
         self._mu = threading.Lock()
+        #: Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): when a
+        #: fair scheduler is attached, ``pop`` serves the handle IT
+        #: selects (weighted fair queueing across tenants within the
+        #: level) instead of the core heap's FIFO head. None — the
+        #: default, and the ``tenancy.enabled: false`` hard off-switch
+        #: — keeps the pop path byte-identical to pre-tenancy behavior
+        #: (one attribute check).
+        self._fair = None
+
+    def set_fair(self, fair) -> None:
+        """Attach a tenancy fair scheduler (duck-typed: ``on_push``,
+        ``select``, ``discard``, ``drop_queue``). Must be attached
+        BEFORE any message is pushed — the fair index only knows
+        handles it saw arrive."""
+        self._fair = fair
 
     # -- queue management ----------------------------------------------------
 
@@ -241,6 +301,8 @@ class MultiLevelQueue:
         err = self._core.remove_queue(name)
         if err == self.ERR_NOT_FOUND:
             raise QueueNotFoundError(name)
+        if self._fair is not None:
+            self._fair.drop_queue(name)
         with self._mu:
             self._caps.pop(name, None)
             gone = [h for h, (qn, _, _) in self._messages.items() if qn == name]
@@ -268,6 +330,8 @@ class MultiLevelQueue:
             self._messages[handle] = (name, message, now)
         err = self._core.push(name, handle, int(message.priority), now)
         if err == 0:
+            if self._fair is not None:
+                self._fair.on_push(name, message, handle)
             return
         with self._mu:
             self._messages.pop(handle, None)
@@ -282,9 +346,33 @@ class MultiLevelQueue:
         entries surfacing here are converted to failed accounting and
         skipped. The measured queue wait is attached to the message as
         ``last_wait_time`` (metadata consumers use it rather than
-        re-deriving from created_at, which may be on a different clock)."""
+        re-deriving from created_at, which may be on a different clock).
+
+        With a tenancy fair scheduler attached, the served handle is
+        the scheduler's pick (lowest weighted virtual time within this
+        level) rather than the heap head; a queue whose only pending
+        work belongs to tenants at their in-flight cap reads as empty
+        — the work is deferred, not lost."""
         while True:
-            err, handle, wait = self._core.pop(name, self._clock.now())
+            if self._fair is not None:
+                sel = self._fair.select(name)
+                if sel is None:
+                    if not self._core.has_queue(name):
+                        raise QueueNotFoundError(name)
+                    raise QueueEmptyError(name)
+                err, wait = self._core.pop_handle(name, sel,
+                                                 self._clock.now())
+                handle = sel
+                if err == self.ERR_EMPTY:
+                    # The fair index was ahead of the core (a
+                    # concurrent admin removal won the race for this
+                    # handle): drop any local record and re-select.
+                    with self._mu:
+                        self._tombstones.discard(handle)
+                        self._messages.pop(handle, None)
+                    continue
+            else:
+                err, handle, wait = self._core.pop(name, self._clock.now())
             if err == self.ERR_NOT_FOUND:
                 raise QueueNotFoundError(name)
             if err == self.ERR_EMPTY:
@@ -336,6 +424,8 @@ class MultiLevelQueue:
             popped = self._core.pop_if(name, handle, self._clock.now())
             if popped == 0:
                 self._core.fail(name, 0.0)
+                if self._fair is not None:
+                    self._fair.discard(name, handle)
                 with self._mu:
                     self._tombstones.discard(handle)
                     self._messages.pop(handle, None)
@@ -406,6 +496,8 @@ class MultiLevelQueue:
         h, msg = target
         if self._core.discard(name, h) != 0:
             return None  # already popped by a concurrent consumer
+        if self._fair is not None:
+            self._fair.discard(name, h)
         with self._mu:
             self._messages.pop(h, None)
         msg.status = MessageStatus.FAILED
@@ -430,19 +522,41 @@ class MultiLevelQueue:
     def expire_older_than(self, name: str, max_age: float) -> List[Message]:
         """Mark pending messages older than ``max_age`` as TIMEOUT.
 
-        They are tombstoned and will be discarded (with failed accounting)
-        when the heap surfaces them; reported sizes exclude them
-        immediately."""
+        Without a fair scheduler they are tombstoned and discarded (with
+        failed accounting) when the heap surfaces them; reported sizes
+        exclude them immediately. With one attached they are drained
+        EAGERLY — a tombstone sitting in a fair deque would keep counting
+        against the tenant's ``max_queue_depth`` quota (and might never
+        surface at all while the tenant is deferred at its in-flight
+        cap), so dead work must leave the fair index and the depth
+        counter the moment it expires."""
         if not self.has_queue(name):
             raise QueueNotFoundError(name)
         cutoff = self._clock.now() - max_age
         expired: List[Message] = []
         with self._mu:
-            for h, (qn, msg, ts) in self._messages.items():
-                if qn == name and ts < cutoff and h not in self._tombstones:
+            stale = [(h, msg) for h, (qn, msg, ts) in self._messages.items()
+                     if qn == name and ts < cutoff
+                     and h not in self._tombstones]
+            if self._fair is None:
+                for h, msg in stale:
                     self._tombstones.add(h)
                     msg.status = MessageStatus.TIMEOUT
                     expired.append(msg)
+                return expired
+        for h, msg in stale:
+            # Same accounting as the tombstone-surfacing drain in pop():
+            # pending → processing (wait sample) → failed. ERR_EMPTY
+            # means a concurrent pop won the race — it's live work now.
+            err, _ = self._core.pop_handle(name, h, self._clock.now())
+            if err != 0:
+                continue
+            self._core.fail(name, 0.0)
+            self._fair.discard(name, h)
+            with self._mu:
+                self._messages.pop(h, None)
+            msg.status = MessageStatus.TIMEOUT
+            expired.append(msg)
         return expired
 
     # -- stats ---------------------------------------------------------------

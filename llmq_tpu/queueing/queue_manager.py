@@ -105,6 +105,24 @@ class QueueManager:
             self.queue.create_queue(Priority(lvl.priority).tier_name,
                                     capacity=self.qconfig.max_queue_size)
 
+        # Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): with
+        # ``tenancy.enabled`` a weighted-fair scheduler reorders pops
+        # WITHIN each tier by tenant virtual time and feeds the shared
+        # registry's depth/in-flight counters. Disabled (the default),
+        # self._fair stays None and every hook below is one attribute
+        # check — the dequeue path is byte-identical to FIFO-within-
+        # priority. Attached BEFORE the WAL restore so recovered
+        # messages enter the fair index like live pushes.
+        self._fair = None
+        tcfg = getattr(self.config, "tenancy", None)
+        if tcfg is not None and getattr(tcfg, "enabled", False):
+            from llmq_tpu import tenancy
+            registry = tenancy.configure_tenancy(tcfg)
+            self._fair = tenancy.FairScheduler(registry,
+                                               clock=self._clock)
+            tenancy.register_scheduler(self._fair)
+            self.queue.set_fair(self._fair)
+
         # Optional durability (the reference loses every pending message
         # on restart — SURVEY §5): journal mutations, replay on startup.
         self._wal = None
@@ -229,6 +247,10 @@ class QueueManager:
             if self._wal:
                 self._wal.append("pop", queue_name, msg.id)
                 self._wal_inflight[msg.id] = (queue_name, msg)
+        if self._fair is not None:
+            # Delivery: charge the tenant's virtual time (estimated
+            # tokens, trued-up at finish) and take an in-flight slot.
+            self._fair.note_pop(msg)
         if self._metrics:
             lbl = (self.name, queue_name, msg.priority.tier_name)
             self._metrics.pending.labels(*lbl).dec()
@@ -254,6 +276,8 @@ class QueueManager:
                 if self._wal:
                     self._wal.append("pop", queue_name, m.id)
                     self._wal_inflight[m.id] = (queue_name, m)
+            if self._fair is not None:
+                self._fair.note_pop(m)
             if self._metrics:
                 lbl = (self.name, queue_name, m.priority.tier_name)
                 self._metrics.pending.labels(*lbl).dec()
@@ -284,6 +308,10 @@ class QueueManager:
             if self._wal:
                 self._wal.append("complete", qname, message.id)
                 self._wal_inflight.pop(message.id, None)
+        if self._fair is not None:
+            # True-up from measured tokens (metadata.usage) + release
+            # the tenant's in-flight slot.
+            self._fair.note_finish(message, ok=True)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -299,6 +327,8 @@ class QueueManager:
             if self._wal:
                 self._wal.append("fail", qname, message.id)
                 self._wal_inflight.pop(message.id, None)
+        if self._fair is not None:
+            self._fair.note_finish(message, ok=False)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -309,6 +339,10 @@ class QueueManager:
     def requeue_message(self, message: Message, queue_name: Optional[str] = None) -> str:
         """Retry path: return a PROCESSING message to its queue."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
+        if self._fair is not None:
+            # Release the in-flight slot BEFORE the re-push: the push
+            # re-enters the fair index as fresh pending work.
+            self._fair.note_requeue(message)
         with self._wal_guard():
             self.queue.requeue(qname, message)
             if self._wal:
@@ -328,6 +362,10 @@ class QueueManager:
         completed/failed transition — it will re-enter via the delayed
         queue after its retry backoff elapses."""
         qname = queue_name or self._pop_inflight(message.id) or self.route_for(message)
+        if self._fair is not None:
+            # Parked for retry backoff: free the tenant's in-flight
+            # slot (the delayed re-push re-enters the fair index).
+            self._fair.note_requeue(message)
         with self._wal_guard():
             self.queue.requeue_accounting_for(qname)
             if self._wal:
@@ -380,6 +418,11 @@ class QueueManager:
 
     def total_pending(self) -> int:
         return self.queue.total_size()
+
+    def fair_snapshot(self) -> Optional[Dict]:
+        """Tenancy fair-dequeue state (virtual times, backlog, served
+        tokens, share ratios) — None when tenancy is disabled."""
+        return self._fair.snapshot() if self._fair is not None else None
 
     def start(self, monitor_interval: float = 5.0) -> None:
         """Start the background monitor (queue_manager.go:469-496)."""

@@ -22,6 +22,7 @@
 #include <mutex>
 #include <queue>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -56,9 +57,23 @@ struct Stats {
 
 struct Queue {
   std::priority_queue<Item, std::vector<Item>, ItemCmp> heap;
+  // Liveness index, handle -> enqueue_ts. mlq_pop_handle/mlq_discard
+  // remove items HERE in O(1) and leave the heap entry behind as a
+  // stale record (lazy deletion); pop/peek skip entries absent from
+  // this map as they surface. Handles are never reused, so membership
+  // alone decides liveness. Size/capacity are measured on this map,
+  // not the heap (the heap may carry stale entries).
+  std::unordered_map<uint64_t, double> live;
   int64_t capacity = 0;  // <=0 means unbounded
   Stats stats;
 };
+
+// Drop stale (lazily deleted) entries off the heap top so heap.top(),
+// when present, is always a live item. Amortized O(log n) per deletion.
+void drain_stale(Queue& qq) {
+  while (!qq.heap.empty() && !qq.live.count(qq.heap.top().handle))
+    qq.heap.pop();
+}
 
 struct MLQ {
   std::mutex mu;
@@ -109,10 +124,11 @@ int64_t mlq_push(void* h, const char* name, uint64_t handle, int32_t priority,
   if (it == q->queues.end()) return ERR_NOT_FOUND;
   Queue& qq = it->second;
   if (qq.capacity > 0 &&
-      static_cast<int64_t>(qq.heap.size()) >= qq.capacity)
+      static_cast<int64_t>(qq.live.size()) >= qq.capacity)
     return ERR_FULL;
   q->next_seq += 1;
   qq.heap.push(Item{priority, q->next_seq, handle, enqueue_ts});
+  qq.live.emplace(handle, enqueue_ts);
   qq.stats.pending += 1;
   return 0;
 }
@@ -127,12 +143,14 @@ int64_t mlq_pop(void* h, const char* name, double now, uint64_t* out_handle,
   auto it = q->queues.find(name);
   if (it == q->queues.end()) return ERR_NOT_FOUND;
   Queue& qq = it->second;
+  drain_stale(qq);
   if (qq.heap.empty()) return ERR_EMPTY;
   const Item& top = qq.heap.top();
   *out_handle = top.handle;
   double wait = now - top.enqueue_ts;
   if (wait < 0) wait = 0;
   if (out_wait) *out_wait = wait;
+  qq.live.erase(top.handle);
   qq.heap.pop();
   qq.stats.pending -= 1;
   qq.stats.processing += 1;
@@ -152,15 +170,48 @@ int64_t mlq_pop_if(void* h, const char* name, uint64_t expected, double now) {
   auto it = q->queues.find(name);
   if (it == q->queues.end()) return ERR_NOT_FOUND;
   Queue& qq = it->second;
+  drain_stale(qq);
   if (qq.heap.empty()) return ERR_EMPTY;
   if (qq.heap.top().handle != expected) return -5;  // ERR_MISMATCH
   double wait = now - qq.heap.top().enqueue_ts;
   if (wait < 0) wait = 0;
+  qq.live.erase(qq.heap.top().handle);
   qq.heap.pop();
   qq.stats.pending -= 1;
   qq.stats.processing += 1;
   qq.stats.pops += 1;
   qq.stats.total_wait += wait;
+  return 0;
+}
+
+// Pops a SPECIFIC pending item by handle with full pop accounting
+// (pending->processing, pops, wait) — the fair-dequeue layer selects
+// the handle to serve (weighted fair queueing across tenants) and this
+// extracts it regardless of heap position. O(1): the item leaves the
+// liveness index only; its heap entry is skipped as stale when it
+// surfaces. A standing backlog therefore costs fair pops nothing —
+// dequeue stays O(log n) regardless of depth.
+int64_t mlq_pop_handle(void* h, const char* name, uint64_t handle,
+                       double now, double* out_wait) {
+  MLQ* q = static_cast<MLQ*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  auto it = q->queues.find(name);
+  if (it == q->queues.end()) return ERR_NOT_FOUND;
+  Queue& qq = it->second;
+  auto lv = qq.live.find(handle);
+  if (lv == qq.live.end()) return ERR_EMPTY;
+  double wait = now - lv->second;
+  if (wait < 0) wait = 0;
+  if (out_wait) *out_wait = wait;
+  qq.live.erase(lv);
+  qq.stats.pending -= 1;
+  qq.stats.processing += 1;
+  qq.stats.pops += 1;
+  qq.stats.total_wait += wait;
+  // The fair pop path never routes through mlq_pop/mlq_peek, so this
+  // is the only place its stale entries get reclaimed — without it the
+  // heap grows by one dead Item per message forever.
+  drain_stale(qq);
   return 0;
 }
 
@@ -170,6 +221,7 @@ int64_t mlq_peek(void* h, const char* name, uint64_t* out_handle) {
   auto it = q->queues.find(name);
   if (it == q->queues.end()) return ERR_NOT_FOUND;
   Queue& qq = it->second;
+  drain_stale(qq);
   if (qq.heap.empty()) return ERR_EMPTY;
   *out_handle = qq.heap.top().handle;
   return 0;
@@ -180,7 +232,7 @@ int64_t mlq_size(void* h, const char* name) {
   std::lock_guard<std::mutex> lock(q->mu);
   auto it = q->queues.find(name);
   if (it == q->queues.end()) return ERR_NOT_FOUND;
-  return static_cast<int64_t>(it->second.heap.size());
+  return static_cast<int64_t>(it->second.live.size());
 }
 
 int64_t mlq_complete(void* h, const char* name, double process_time) {
@@ -209,28 +261,17 @@ int64_t mlq_fail(void* h, const char* name, double process_time) {
 
 // Remove a PENDING item by handle (admin deletion). Unlike the
 // tombstone path, this touches no wait/processing/failed accounting —
-// the item simply leaves pending. O(n) heap rebuild; admin-rate only.
+// the item simply leaves pending. O(1) lazy deletion like
+// mlq_pop_handle.
 int64_t mlq_discard(void* h, const char* name, uint64_t handle) {
   MLQ* q = static_cast<MLQ*>(h);
   std::lock_guard<std::mutex> lock(q->mu);
   auto it = q->queues.find(name);
   if (it == q->queues.end()) return ERR_NOT_FOUND;
   Queue& qq = it->second;
-  std::vector<Item> keep;
-  keep.reserve(qq.heap.size());
-  bool found = false;
-  while (!qq.heap.empty()) {
-    const Item& top = qq.heap.top();
-    if (!found && top.handle == handle) {
-      found = true;
-    } else {
-      keep.push_back(top);
-    }
-    qq.heap.pop();
-  }
-  for (const Item& item : keep) qq.heap.push(item);
-  if (!found) return ERR_EMPTY;
+  if (qq.live.erase(handle) == 0) return ERR_EMPTY;
   qq.stats.pending -= 1;
+  drain_stale(qq);
   return 0;
 }
 

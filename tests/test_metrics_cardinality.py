@@ -147,3 +147,53 @@ class TestTenantLabelBound:
         led = UsageLedger(max_tenants=2)
         labels = {led.tenant_label(f"team-{i}") for i in range(10)}
         assert labels == {"team-0", "team-1", "other"}
+
+    def test_tenancy_fairness_families_share_the_bound(self):
+        """The tenancy plane's per-tenant gauges (tenant_virtual_time,
+        tenant_share_ratio, tenant_inflight) flush through the SAME
+        first-come ``max_tenants`` mapping as the usage families — a
+        tenant-id spray through the fair scheduler must collapse to
+        "other", never mint a series per sprayed id."""
+        from llmq_tpu import tenancy
+        from llmq_tpu.core.config import TenancyConfig
+        from llmq_tpu.metrics.registry import exposition
+        from llmq_tpu.observability.usage import (get_usage_ledger,
+                                                  reset_usage)
+        reset_usage()
+        get_usage_ledger().reconfigure(enabled=True, max_tenants=2)
+        tenancy.reset_tenancy()
+        reg = tenancy.configure_tenancy(TenancyConfig(enabled=True))
+        sched = tenancy.FairScheduler(reg)
+        tenancy.register_scheduler(sched)
+        try:
+            class _Msg:
+                def __init__(self, i):
+                    self.id = f"card-{i}"
+                    self.tenant_id = f"sprayed-tenant-{i}"
+                    self.content = "x" * 40
+                    self.metadata = {}
+            for i in range(20):
+                m = _Msg(i)
+                sched.on_push("normal", m, i + 1)
+                assert sched.select("normal") == i + 1
+                sched.note_pop(m)
+                sched.note_finish(m)
+            exp = exposition().decode()
+            tenant_values = set()
+            for fam in _families():
+                if not fam.name.startswith("llm_queue_tenant_"):
+                    continue
+                for sample in fam.samples:
+                    t = sample.labels.get("tenant")
+                    if t is not None and t.startswith(
+                            ("sprayed-tenant-", "other")):
+                        tenant_values.add(t)
+            # 2 first-come series + "other"; the other 18 sprayed ids
+            # never appear (checked against the raw exposition too).
+            assert "other" in tenant_values
+            assert len(tenant_values) <= 3, tenant_values
+            for i in range(2, 20):
+                assert f'tenant="sprayed-tenant-{i}"' not in exp
+        finally:
+            tenancy.reset_tenancy()
+            reset_usage()

@@ -174,3 +174,68 @@ class TestRequeue:
         assert s.processing_count == 0
         assert s.completed_count == 0 and s.failed_count == 0
         assert mlq.pop("q").id == m.id
+
+
+class TestLazyExtraction:
+    """pop_handle/discard are O(1) LAZY deletions in both cores
+    (the tenancy fair-dequeue extraction op, docs/tenancy.md): the
+    item leaves the liveness index immediately while its heap entry
+    stays behind as a stale record. pop/peek/pop_if must skip stale
+    entries, and size/capacity must track liveness, not heap length."""
+
+    ERR_EMPTY = -3
+
+    @pytest.fixture
+    def core(self, queue_backend):
+        if queue_backend == "python":
+            from llmq_tpu.queueing.priority_queue import _PyBackend
+            return _PyBackend()
+        from llmq_tpu.native.loader import NativeMLQ
+        return NativeMLQ()
+
+    def test_pop_skips_extracted_entries(self, core):
+        core.create_queue("q", 0)
+        for h in (1, 2, 3, 4):
+            core.push("q", h, 1, 0.0)
+        err, wait = core.pop_handle("q", 2, 5.0)
+        assert err == 0 and wait == 5.0
+        assert [core.pop("q", 5.0)[1] for _ in range(3)] == [1, 3, 4]
+        assert core.pop("q", 5.0)[0] == self.ERR_EMPTY
+
+    def test_peek_and_pop_if_skip_stale_top(self, core):
+        core.create_queue("q", 0)
+        core.push("q", 1, 1, 0.0)    # heap top
+        core.push("q", 2, 1, 0.0)
+        assert core.pop_handle("q", 1, 0.0)[0] == 0
+        assert core.peek("q") == (0, 2)
+        assert core.pop_if("q", 2, 0.0) == 0
+        assert core.peek("q")[0] == self.ERR_EMPTY
+
+    def test_extract_missing_handle_is_empty(self, core):
+        core.create_queue("q", 0)
+        core.push("q", 1, 1, 0.0)
+        assert core.pop_handle("q", 99, 0.0)[0] == self.ERR_EMPTY
+        assert core.pop_handle("q", 1, 0.0)[0] == 0
+        # Already extracted — the stale heap entry is not re-poppable.
+        assert core.pop_handle("q", 1, 0.0)[0] == self.ERR_EMPTY
+        assert core.discard("q", 1) == self.ERR_EMPTY
+
+    def test_capacity_and_size_track_liveness(self, core):
+        core.create_queue("q", 2)
+        assert core.push("q", 1, 1, 0.0) == 0
+        assert core.push("q", 2, 1, 0.0) == 0
+        assert core.push("q", 3, 1, 0.0) == -2          # ERR_FULL
+        assert core.pop_handle("q", 1, 0.0)[0] == 0
+        assert core.size("q") == 1
+        # The stale heap entry must not count against capacity.
+        assert core.push("q", 3, 1, 0.0) == 0
+        assert core.size("q") == 2
+        assert [core.pop("q", 0.0)[1] for _ in range(2)] == [2, 3]
+
+    def test_discard_is_lazy_too(self, core):
+        core.create_queue("q", 0)
+        for h in (1, 2, 3):
+            core.push("q", h, 1, 0.0)
+        assert core.discard("q", 2) == 0
+        assert core.size("q") == 2
+        assert [core.pop("q", 0.0)[1] for _ in range(2)] == [1, 3]
