@@ -1,0 +1,18 @@
+"""Correctness-tooling plane (docs/analysis.md).
+
+Runtime instruments that make concurrency and invariant bugs
+mechanically detectable instead of convention-enforced:
+
+- ``lockdep`` — lock-order-graph instrument over ``threading.Lock`` /
+  ``RLock`` (potential-deadlock cycles, held-lock blocking calls);
+  opt-in via ``LLMQ_LOCKDEP=1``.
+
+The static half of the plane lives in ``scripts/analysis/``
+(``lint_invariants.py``, ``run_mypy.py``, ``run_sanitizers.py``) — it
+analyses the tree rather than the running process, so it ships as
+scripts, not importable library code.
+"""
+
+from llmq_tpu.analysis import lockdep
+
+__all__ = ["lockdep"]

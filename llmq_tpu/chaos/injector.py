@@ -55,7 +55,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from llmq_tpu.utils.logging import get_logger
 
@@ -162,7 +162,8 @@ class FaultInjector:
     def add_rule(self, point: str, kind: str = "error",
                  probability: float = 1.0, times: int = 0,
                  after: int = 0, latency_ms: float = 0.0,
-                 match: Optional[Dict] = None, **extra_match) -> FaultRule:
+                 match: Optional[Dict] = None,
+                 **extra_match: Any) -> FaultRule:
         """Register one rule (config load and programmatic tests share
         this path). Keyword args beyond the rule fields become context
         equality filters, e.g. ``add_rule("transport.request",
@@ -217,7 +218,7 @@ class FaultInjector:
         except Exception:  # noqa: BLE001 — injection must not couple
             pass           # to the metrics plane
 
-    def fault(self, point: str, **ctx) -> None:
+    def fault(self, point: str, **ctx: Any) -> None:
         """Evaluate ``point`` against the rules; raise/sleep per the
         first rule that fires, else return."""
         rule = self._arm(point, ctx)
@@ -259,7 +260,7 @@ class FaultInjector:
 _injector: Optional[FaultInjector] = None
 
 
-def configure(cfg) -> Optional[FaultInjector]:
+def configure(cfg: Any) -> Optional[FaultInjector]:
     """Install the process injector from a ``core.config.ChaosConfig``
     (or anything with ``enabled``/``seed``/``faults`` fields). Disabled
     or None tears the injector down."""
@@ -279,7 +280,7 @@ def get_injector() -> Optional[FaultInjector]:
     return _injector
 
 
-def fault(point: str, **ctx) -> None:
+def fault(point: str, **ctx: Any) -> None:
     """The one-line seam instrumented code calls. No-op (one attribute
     check) when chaos is disabled."""
     inj = _injector

@@ -25,7 +25,7 @@ fire from engine threads) with one terminal ``check()`` that raises
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 class InvariantChecker:
@@ -47,7 +47,7 @@ class InvariantChecker:
         with self._mu:
             self._submitted.append(request_id)
 
-    def on_token(self, request_id: str):
+    def on_token(self, request_id: str) -> Callable[[int], None]:
         """Returns a ``cb(token_id)`` suitable for ``GenHandle.on_token``
         / the SSE path, recording the stream for the monotonicity check."""
         def cb(token: int) -> None:

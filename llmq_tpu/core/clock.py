@@ -47,7 +47,7 @@ class FakeClock:
     health checks) be driven without real sleeping.
     """
 
-    def __init__(self, start: float = 1_000_000.0):
+    def __init__(self, start: float = 1_000_000.0) -> None:
         self._now = start
         self._lock = threading.Lock()
         self._waiters: List[Tuple[float, threading.Condition]] = []

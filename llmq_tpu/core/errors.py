@@ -16,26 +16,26 @@ class LLMQError(Exception):
 # --- queue plane (parity: queue.go:213-217) ---------------------------------
 
 class QueueNotFoundError(LLMQError, KeyError):
-    def __init__(self, name: str):
+    def __init__(self, name: str) -> None:
         super().__init__(f"queue not found: {name}")
         self.queue_name = name
 
 
 class QueueFullError(LLMQError):
-    def __init__(self, name: str, capacity: int):
+    def __init__(self, name: str, capacity: int) -> None:
         super().__init__(f"queue full: {name} (capacity {capacity})")
         self.queue_name = name
         self.capacity = capacity
 
 
 class QueueEmptyError(LLMQError):
-    def __init__(self, name: str):
+    def __init__(self, name: str) -> None:
         super().__init__(f"queue empty: {name}")
         self.queue_name = name
 
 
 class MessageNotFoundError(LLMQError, KeyError):
-    def __init__(self, message_id: str):
+    def __init__(self, message_id: str) -> None:
         super().__init__(f"message not found: {message_id}")
         self.message_id = message_id
 
@@ -43,7 +43,7 @@ class MessageNotFoundError(LLMQError, KeyError):
 # --- conversation service ---------------------------------------------------
 
 class ConversationNotFoundError(LLMQError, KeyError):
-    def __init__(self, conversation_id: str):
+    def __init__(self, conversation_id: str) -> None:
         super().__init__(f"conversation not found: {conversation_id}")
         self.conversation_id = conversation_id
 
@@ -59,7 +59,7 @@ class NoEndpointError(LLMQError):
 
 
 class AllocationNotFoundError(LLMQError, KeyError):
-    def __init__(self, allocation_id: str):
+    def __init__(self, allocation_id: str) -> None:
         super().__init__(f"allocation not found: {allocation_id}")
         self.allocation_id = allocation_id
 

@@ -2543,6 +2543,9 @@ class InferenceEngine:
             # Cost next to latency: the trace/flight-recorder surfaces
             # show this request's attributed usage.
             meta["usage"] = seq.handle.usage
+        # Trace timestamps must share the flight recorder's wall-clock
+        # timeline (W3C trace alignment), not the engine's injectable
+        # clock.  # lint: allow-wallclock
         events.append((terminal, time.time(), meta))
         rec.record_many(seq.req.id, events)
 

@@ -8,7 +8,7 @@ Llama-3-8B/70B weights (BASELINE configs #2-#5)."""
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

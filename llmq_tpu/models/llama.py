@@ -22,7 +22,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 

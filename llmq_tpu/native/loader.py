@@ -20,6 +20,14 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _SRC = os.path.join(_REPO_ROOT, "native", "src", "mlq.cpp")
 _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_libmlq.so")
 
+#: Absolute-path override for the loaded library. The sanitizer harness
+#: (scripts/analysis/run_sanitizers.py, docs/analysis.md) points this at
+#: an asan/ubsan-instrumented variant from native/build/ so the REAL
+#: Python queue suites drive the instrumented core; the override is
+#: loaded as-is (no rebuild, no mtime check) and a missing/unloadable
+#: path is a hard error, not a silent fallback to the production .so.
+_ENV_OVERRIDE = "LLMQ_NATIVE_LIB"
+
 ERR_NOT_FOUND = -1
 ERR_FULL = -2
 ERR_EMPTY = -3
@@ -37,7 +45,8 @@ def _build_if_needed() -> bool:
         return True
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared", "-o", _SO, _SRC],
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+             "-Werror", "-shared", "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -53,15 +62,23 @@ def load_native() -> Optional[ctypes.CDLL]:
             return _lib
         if _load_failed:
             return None
-        if not _build_if_needed():
-            _load_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            log.warning("native queue core load failed; using Python fallback: %s", e)
-            _load_failed = True
-            return None
+        override = os.environ.get(_ENV_OVERRIDE, "")
+        if override:
+            # An explicit override must fail loudly: the caller asked
+            # for a specific (typically sanitizer-instrumented) build,
+            # and silently testing the production .so instead would
+            # defeat the harness.
+            lib = ctypes.CDLL(override)
+        else:
+            if not _build_if_needed():
+                _load_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as e:
+                log.warning("native queue core load failed; using Python fallback: %s", e)
+                _load_failed = True
+                return None
         lib.mlq_create.restype = ctypes.c_void_p
         lib.mlq_create.argtypes = []
         lib.mlq_destroy.restype = None

@@ -22,12 +22,12 @@ model shapes all divide.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Dict
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from llmq_tpu.models.llama import KVCache, LlamaConfig, Params
+from llmq_tpu.models.llama import LlamaConfig, Params
 from llmq_tpu.utils.logging import get_logger
 
 log = get_logger("sharding")

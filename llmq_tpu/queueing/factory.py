@@ -157,7 +157,9 @@ class QueueFactory:
 
     def create_workers(self, manager_name: str, count: int,
                        process_fn: ProcessFn, start: bool = True,
-                       on_permanent_failure=None) -> List[Worker]:
+                       on_permanent_failure: Optional[
+                           Callable[[Message, str], None]] = None,
+                       ) -> List[Worker]:
         with self._lock:
             entry = self._entries.get(manager_name)
         if entry is None:

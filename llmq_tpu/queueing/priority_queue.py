@@ -24,8 +24,9 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.errors import (
@@ -239,13 +240,17 @@ class _PyBackend:
             return sorted(self._heaps)
 
 
-def _make_backend(backend: str):
+def _make_backend(backend: str) -> Any:
     if backend in ("auto", "native"):
         try:
             from llmq_tpu.native.loader import NativeMLQ
             return NativeMLQ()
         except Exception as e:  # noqa: BLE001
-            if backend == "native":
+            # An explicit LLMQ_NATIVE_LIB override must never fall back
+            # silently: the caller asked for a specific (e.g. sanitizer
+            # -instrumented) core, and a green run against _PyBackend
+            # would be a false all-clear.
+            if backend == "native" or os.environ.get("LLMQ_NATIVE_LIB"):
                 raise
             log.info("using Python queue backend (%s)", e)
     return _PyBackend()
@@ -281,7 +286,7 @@ class MultiLevelQueue:
         #: (one attribute check).
         self._fair = None
 
-    def set_fair(self, fair) -> None:
+    def set_fair(self, fair: Any) -> None:
         """Attach a tenancy fair scheduler (duck-typed: ``on_push``,
         ``select``, ``discard``, ``drop_queue``). Must be attached
         BEFORE any message is pushed — the fair index only knows

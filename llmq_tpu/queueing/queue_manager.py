@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, ContextManager, Dict, List, Optional
 
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.config import Config, QueueConfig, default_config
@@ -402,7 +402,7 @@ class QueueManager:
         with self._inflight_mu:
             return self._inflight.pop(message_id, None)
 
-    def _wal_guard(self):
+    def _wal_guard(self) -> ContextManager[object]:
         """Lock pairing a queue mutation with its WAL bookkeeping so the
         monitor's compaction sees a consistent live set; free (nullcontext)
         when durability is off."""

@@ -33,7 +33,7 @@ import itertools
 import queue as _queue
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from llmq_tpu import observability
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
@@ -87,7 +87,8 @@ class _Inflight:
                  "_mu")
 
     def __init__(self, msg: Message, ctx: ProcessContext, start: float,
-                 deadline: float, pool=None) -> None:
+                 deadline: float,
+                 pool: Optional["_DispatchPool"] = None) -> None:
         self.msg = msg
         self.ctx = ctx
         self.start = start
@@ -163,7 +164,7 @@ class _DispatchPool:
             with self._mu:
                 self._live.discard(me)
 
-    def submit(self, fn, *args) -> None:
+    def submit(self, fn: Callable[..., None], *args: Any) -> None:
         with self._mu:
             if self._shut:
                 raise RuntimeError("dispatch pool is shut down")
@@ -199,9 +200,12 @@ class _DispatchPool:
         if wait:
             # One overall deadline — wedged threads never consume their
             # sentinel, and stop() must be bounded regardless of how
-            # many are stuck.
-            deadline = _time.monotonic() + 5.0
+            # many are stuck. Real wall time on purpose: thread joins
+            # block in the OS, so a FakeClock (which never advances on
+            # its own) would turn this bound into a hang.
+            deadline = _time.monotonic() + 5.0  # lint: allow-wallclock
             for t in live:
+                # lint: allow-wallclock — same wall-time join bound
                 t.join(timeout=max(0.0, deadline - _time.monotonic()))
 
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.config import SchedulerConfig

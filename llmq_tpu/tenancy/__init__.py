@@ -24,10 +24,13 @@ from __future__ import annotations
 import math
 import threading
 import weakref
-from typing import Dict, Optional
+from typing import TYPE_CHECKING, Any, Dict, Optional
 
 from llmq_tpu.tenancy.fair_queue import (FairScheduler,
                                          share_ratios_from_window)
+if TYPE_CHECKING:  # import cycle: core.config is config-layer
+    from llmq_tpu.core.config import TenancyConfig
+
 from llmq_tpu.tenancy.registry import (QUOTA_REASONS, TenantRegistry,
                                        estimate_tokens)
 
@@ -45,7 +48,7 @@ def get_tenant_registry() -> TenantRegistry:
         return _REGISTRY
 
 
-def configure_tenancy(cfg) -> TenantRegistry:
+def configure_tenancy(cfg: "TenancyConfig") -> TenantRegistry:
     """Apply a ``tenancy`` config block (core.config.TenancyConfig or
     same-shaped object) onto the singleton registry."""
     reg = get_tenant_registry()
@@ -77,7 +80,7 @@ _FLUSHED: Dict[str, set] = {"inflight": set(), "vt": set(), "share": set()}
 _FLUSH_MU = threading.Lock()
 
 
-def _set_series(gauge, family: str, values: Dict[str, float]) -> None:
+def _set_series(gauge: Any, family: str, values: Dict[str, float]) -> None:
     """Write one gauge family's current label→value set and remove any
     series flushed last round that has no current value."""
     for lab, v in values.items():

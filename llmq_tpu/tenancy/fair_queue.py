@@ -42,8 +42,9 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
+from llmq_tpu.core.clock import Clock
 from llmq_tpu.observability.usage import sanitize_tenant
 from llmq_tpu.tenancy.registry import TenantRegistry, estimate_tokens
 from llmq_tpu.utils.logging import get_logger
@@ -53,7 +54,8 @@ log = get_logger("tenancy.fair")
 
 def share_ratios_from_window(registry: TenantRegistry,
                              window: Dict[str, int],
-                             *, key=None) -> Dict[str, float]:
+                             *, key: Optional[Callable[[str], str]] = None,
+                             ) -> Dict[str, float]:
     """Achieved token share ÷ configured weight share for one rolling
     window of served tokens (tenant → tokens). The weight denominator
     is the sum over tenants ACTIVE in the window — fairness is judged
@@ -96,7 +98,8 @@ class FairScheduler:
     #: Bounded pop-estimate records awaiting their finish true-up.
     MAX_PENDING_EST = 8192
 
-    def __init__(self, registry: TenantRegistry, *, clock=None) -> None:
+    def __init__(self, registry: TenantRegistry, *,
+                 clock: Optional[Clock] = None) -> None:
         self.registry = registry
         #: Clock for the rolling share window (the manager passes its
         #: own, so fake-clock tests can age entries deterministically).
@@ -126,11 +129,13 @@ class FairScheduler:
 
     def _now(self) -> float:
         return (self._clock.now() if self._clock is not None
-                else time.monotonic())
+                else time.monotonic())  # lint: allow-wallclock — no
+        # clock attached (standalone scheduler): wall time is the only
+        # feed for the rolling share window.
 
     # -- queue-side hooks (called by MultiLevelQueue) ------------------------
 
-    def on_push(self, qname: str, message, handle: int) -> None:
+    def on_push(self, qname: str, message: Any, handle: int) -> None:
         tenant = sanitize_tenant(getattr(message, "tenant_id", ""))
         with self._mu:
             per_tenant = self._qs.setdefault(qname, {})
@@ -281,7 +286,7 @@ class FairScheduler:
 
     # -- manager-side hooks (delivery / finish) ------------------------------
 
-    def note_pop(self, msg) -> None:
+    def note_pop(self, msg: Any) -> None:
         """A selected message was DELIVERED to a consumer: charge the
         tenant's virtual time with the admission-time token estimate
         and take an in-flight slot. (Tombstoned entries never get here
@@ -296,7 +301,7 @@ class FairScheduler:
             while len(self._est) > self.MAX_PENDING_EST:
                 self._est.popitem(last=False)
 
-    def note_finish(self, msg, ok: bool = True) -> None:
+    def note_finish(self, msg: Any, ok: bool = True) -> None:
         """The message reached a terminal state: release the in-flight
         slot and TRUE UP the virtual-time charge from measured tokens
         (``metadata.usage`` — the usage ledger's per-request counts
@@ -326,7 +331,7 @@ class FairScheduler:
                 self.served_tokens.move_to_end(tenant)
                 self._trim_tenants_locked()
 
-    def note_requeue(self, msg) -> None:
+    def note_requeue(self, msg: Any) -> None:
         """The message left PROCESSING without finishing (retry stash /
         requeue): free its in-flight slot. The pop-time charge stays —
         the attempt consumed service capacity, and the re-pop will be

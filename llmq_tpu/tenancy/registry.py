@@ -52,14 +52,14 @@ _CHARS_PER_TOKEN = 4.0
 _DEFAULT_COMPLETION_TOKENS = 64
 
 
-def estimate_prompt_tokens(msg) -> int:
+def estimate_prompt_tokens(msg: Any) -> int:
     """Prompt-only token estimate (chars/4); shared by every admission
     gate so quota accounting and shed heuristics can't silently drift
     onto different figures."""
     return int(len(getattr(msg, "content", "") or "") / _CHARS_PER_TOKEN)
 
 
-def estimate_tokens(msg) -> int:
+def estimate_tokens(msg: Any) -> int:
     """Admission-time token estimate for one message: prompt chars/4
     plus the requested (or default) completion budget. Trued-up against
     the usage ledger's measured counts at finish."""
@@ -110,7 +110,7 @@ class TenantRegistry:
 
     # -- configuration -------------------------------------------------------
 
-    def configure(self, cfg) -> None:
+    def configure(self, cfg: Any) -> None:
         """Apply a ``tenancy`` config block (core.config.TenancyConfig
         or same-shaped object) in place — singleton contract, like the
         usage ledger's ``reconfigure``."""

@@ -1,0 +1,280 @@
+// Concurrent stress driver for the native queue core (mlq.cpp), built
+// under asan/ubsan/tsan by native/Makefile (docs/analysis.md).
+//
+// N threads hammer one shared MLQ with a seeded mix of every C-ABI op:
+// push, pop, pop_if (peek-then-check-and-pop), pop_handle (the fair
+// dequeue's arbitrary-position extraction), discard, the
+// expire_older_than interleaving (pop_handle + fail, exactly what
+// MultiLevelQueue.expire_older_than issues per stale handle),
+// complete/fail/requeue accounting, stats, size and queue_names — plus
+// a low-rate remove_queue/create_queue churn so every op also races
+// queue-map mutation. This exercises the lazy-deletion fair-extraction
+// and stale-drain paths specifically: a large fraction of removals go
+// through pop_handle/discard, leaving stale heap entries for
+// concurrent pop/peek/pop_if to skip.
+//
+// Conservation invariant checked at exit (handles are never reused, so
+// each must leave the queue exactly once):
+//     pushes == pops + pop_ifs + pop_handles + discards + drained
+// Any sanitizer report or invariant failure exits nonzero.
+//
+// Usage: stress_mlq [threads] [ops_per_thread] [seed]
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mlq_create();
+void mlq_destroy(void* h);
+int64_t mlq_create_queue(void* h, const char* name, int64_t capacity);
+int64_t mlq_remove_queue(void* h, const char* name);
+int64_t mlq_has_queue(void* h, const char* name);
+int64_t mlq_push(void* h, const char* name, uint64_t handle, int32_t priority,
+                 double enqueue_ts);
+int64_t mlq_pop(void* h, const char* name, double now, uint64_t* out_handle,
+                double* out_wait);
+int64_t mlq_pop_if(void* h, const char* name, uint64_t expected, double now);
+int64_t mlq_pop_handle(void* h, const char* name, uint64_t handle, double now,
+                       double* out_wait);
+int64_t mlq_peek(void* h, const char* name, uint64_t* out_handle);
+int64_t mlq_size(void* h, const char* name);
+int64_t mlq_complete(void* h, const char* name, double process_time);
+int64_t mlq_fail(void* h, const char* name, double process_time);
+int64_t mlq_discard(void* h, const char* name, uint64_t handle);
+int64_t mlq_requeue_accounting(void* h, const char* name);
+int64_t mlq_stats(void* h, const char* name, int64_t* out_i, double* out_d);
+int64_t mlq_queue_names(void* h, char* buf, int64_t buflen);
+}
+
+namespace {
+
+const char* kQueues[] = {"realtime", "high", "normal", "low"};
+constexpr int kNumQueues = 4;
+// "low" is capacity-bounded so ERR_FULL paths run under contention.
+constexpr int64_t kLowCapacity = 256;
+
+// A bounded ring of recently-pushed handles shared across threads so
+// pop_handle/discard/expire target handles OTHER threads pushed — the
+// cross-thread extraction interleaving the fair scheduler produces.
+// Entries may be stale (already removed); the core must answer
+// ERR_EMPTY for those, never crash. Slots are atomics: concurrent
+// publish/consume is part of the workload by design.
+constexpr int kRingSize = 4096;
+std::atomic<uint64_t> g_ring[kRingSize];
+std::atomic<uint64_t> g_ring_widx{0};
+
+void ring_publish(uint64_t handle, int queue_idx) {
+  // Pack the queue index into the top bits; handles stay < 2^56.
+  uint64_t slot = g_ring_widx.fetch_add(1, std::memory_order_relaxed);
+  g_ring[slot % kRingSize].store(
+      (static_cast<uint64_t>(queue_idx) << 56) | handle,
+      std::memory_order_release);
+}
+
+bool ring_steal(std::mt19937_64& rng, uint64_t* handle, int* queue_idx) {
+  uint64_t packed =
+      g_ring[rng() % kRingSize].exchange(0, std::memory_order_acq_rel);
+  if (packed == 0) return false;
+  *queue_idx = static_cast<int>(packed >> 56);
+  *handle = packed & ((1ULL << 56) - 1);
+  return true;
+}
+
+struct Counters {
+  uint64_t pushes = 0;
+  uint64_t pops = 0;
+  uint64_t pop_ifs = 0;
+  uint64_t pop_handles = 0;
+  uint64_t discards = 0;
+};
+
+std::atomic<uint64_t> g_next_handle{1};
+std::atomic<double> g_now{1000.0};
+void* g_mlq = nullptr;
+
+void worker(int tid, uint64_t seed, int ops, Counters* out) {
+  std::mt19937_64 rng(seed + static_cast<uint64_t>(tid) * 7919);
+  Counters c;
+  uint64_t out_h = 0;
+  double out_w = 0.0;
+  int64_t out_i[5];
+  double out_d[2];
+  char namebuf[1024];
+
+  for (int i = 0; i < ops; ++i) {
+    int queue_idx = static_cast<int>(rng() % kNumQueues);
+    const char* q = kQueues[queue_idx];
+    double now = g_now.load(std::memory_order_relaxed) + i * 1e-6;
+    switch (rng() % 16) {
+      case 0: case 1: case 2: case 3: case 4: {  // push (heaviest op)
+        uint64_t h = g_next_handle.fetch_add(1, std::memory_order_relaxed);
+        int32_t prio = static_cast<int32_t>(rng() % 4);
+        if (mlq_push(g_mlq, q, h, prio, now) == 0) {
+          c.pushes += 1;
+          ring_publish(h, queue_idx);
+        }
+        break;
+      }
+      case 5: case 6: {  // pop
+        if (mlq_pop(g_mlq, q, now, &out_h, &out_w) == 0) {
+          c.pops += 1;
+          if (rng() % 2)
+            mlq_complete(g_mlq, q, 0.001);
+          else if (rng() % 2)
+            mlq_fail(g_mlq, q, 0.001);
+          else
+            mlq_requeue_accounting(g_mlq, q);
+        }
+        break;
+      }
+      case 7: {  // peek + pop_if (the tombstone-drain interleaving)
+        if (mlq_peek(g_mlq, q, &out_h) == 0) {
+          if (mlq_pop_if(g_mlq, q, out_h, now) == 0) {
+            c.pop_ifs += 1;
+            mlq_fail(g_mlq, q, 0.0);
+          }
+        }
+        break;
+      }
+      case 8: case 9: {  // pop_handle: the fair-extraction path
+        uint64_t h;
+        int qi;
+        if (ring_steal(rng, &h, &qi) &&
+            mlq_pop_handle(g_mlq, kQueues[qi], h, now, &out_w) == 0) {
+          c.pop_handles += 1;
+          mlq_complete(g_mlq, kQueues[qi], 0.002);
+        }
+        break;
+      }
+      case 10: {  // expire_older_than interleaving: pop_handle + fail
+        uint64_t h;
+        int qi;
+        if (ring_steal(rng, &h, &qi) &&
+            mlq_pop_handle(g_mlq, kQueues[qi], h, now, &out_w) == 0) {
+          c.pop_handles += 1;
+          mlq_fail(g_mlq, kQueues[qi], 0.0);
+        }
+        break;
+      }
+      case 11: {  // discard (admin removal; lazy deletion)
+        uint64_t h;
+        int qi;
+        if (ring_steal(rng, &h, &qi) &&
+            mlq_discard(g_mlq, kQueues[qi], h) == 0) {
+          c.discards += 1;
+        }
+        break;
+      }
+      case 12: {  // stats + size under concurrent mutation
+        mlq_stats(g_mlq, q, out_i, out_d);
+        mlq_size(g_mlq, q);
+        break;
+      }
+      case 13: {  // queue_names string assembly vs map churn
+        mlq_queue_names(g_mlq, namebuf, sizeof(namebuf));
+        break;
+      }
+      case 14: {  // has_queue + push to a possibly-missing queue
+        mlq_has_queue(g_mlq, "ephemeral");
+        uint64_t h = g_next_handle.fetch_add(1, std::memory_order_relaxed);
+        // ERR_NOT_FOUND most of the time; occasionally lands while the
+        // churn thread (case 15) has the queue alive. Don't count it:
+        // ephemeral's contents die with remove_queue.
+        mlq_push(g_mlq, "ephemeral", h, 0, now);
+        break;
+      }
+      case 15: {  // queue-map churn: create/remove an ephemeral queue
+        if (rng() % 2) {
+          mlq_create_queue(g_mlq, "ephemeral", 64);
+        } else {
+          mlq_remove_queue(g_mlq, "ephemeral");
+        }
+        break;
+      }
+    }
+  }
+  *out = c;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int threads = argc > 1 ? std::atoi(argv[1]) : 8;
+  int ops = argc > 2 ? std::atoi(argv[2]) : 120000;
+  uint64_t seed = argc > 3 ? std::strtoull(argv[3], nullptr, 10) : 1234;
+  if (threads < 1 || ops < 1) {
+    std::fprintf(stderr, "usage: %s [threads>=1] [ops>=1] [seed]\n", argv[0]);
+    return 2;
+  }
+
+  g_mlq = mlq_create();
+  for (const char* q : kQueues)
+    mlq_create_queue(g_mlq, q, std::strcmp(q, "low") == 0 ? kLowCapacity : 0);
+  for (auto& slot : g_ring) slot.store(0, std::memory_order_relaxed);
+
+  std::vector<std::thread> pool;
+  std::vector<Counters> results(static_cast<size_t>(threads));
+  for (int t = 0; t < threads; ++t)
+    pool.emplace_back(worker, t, seed, ops, &results[static_cast<size_t>(t)]);
+  for (auto& th : pool) th.join();
+
+  Counters total;
+  for (const Counters& c : results) {
+    total.pushes += c.pushes;
+    total.pops += c.pops;
+    total.pop_ifs += c.pop_ifs;
+    total.pop_handles += c.pop_handles;
+    total.discards += c.discards;
+  }
+
+  // Quiesce: make sure the ephemeral queue is gone (its contents are
+  // excluded from conservation), then drain the four real queues.
+  mlq_remove_queue(g_mlq, "ephemeral");
+  uint64_t drained = 0;
+  uint64_t out_h = 0;
+  double out_w = 0.0;
+  for (const char* q : kQueues) {
+    while (mlq_pop(g_mlq, q, 2000.0, &out_h, &out_w) == 0) {
+      drained += 1;
+      mlq_complete(g_mlq, q, 0.0);
+    }
+    int64_t sz = mlq_size(g_mlq, q);
+    if (sz != 0) {
+      std::fprintf(stderr, "FAIL: queue %s reports size %lld after drain\n",
+                   q, static_cast<long long>(sz));
+      return 1;
+    }
+  }
+
+  uint64_t removed =
+      total.pops + total.pop_ifs + total.pop_handles + total.discards;
+  std::printf(
+      "stress_mlq: %d threads x %d ops, seed %llu\n"
+      "  pushes=%llu pops=%llu pop_ifs=%llu pop_handles=%llu "
+      "discards=%llu drained=%llu\n",
+      threads, ops, static_cast<unsigned long long>(seed),
+      static_cast<unsigned long long>(total.pushes),
+      static_cast<unsigned long long>(total.pops),
+      static_cast<unsigned long long>(total.pop_ifs),
+      static_cast<unsigned long long>(total.pop_handles),
+      static_cast<unsigned long long>(total.discards),
+      static_cast<unsigned long long>(drained));
+  if (total.pushes != removed + drained) {
+    std::fprintf(stderr,
+                 "FAIL: conservation violated: pushes=%llu != removed=%llu "
+                 "+ drained=%llu\n",
+                 static_cast<unsigned long long>(total.pushes),
+                 static_cast<unsigned long long>(removed),
+                 static_cast<unsigned long long>(drained));
+    return 1;
+  }
+  mlq_destroy(g_mlq);
+  std::puts("stress_mlq: OK (conservation holds, no sanitizer reports)");
+  return 0;
+}

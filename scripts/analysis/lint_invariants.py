@@ -1,0 +1,737 @@
+#!/usr/bin/env python
+"""Invariant lint suite: AST checks for the rules this repo states in
+prose (docs/analysis.md). One check = one class.
+
+The repo's structural invariants — every metric label declared in
+LABEL_CONTRACT, every config field present in the canonical YAML and
+docs, every subsystem behind a hard off-switch, Clock discipline, no
+bare print, no swallowed BaseException — were previously enforced by
+convention plus one grep lint. This linter makes them mechanical:
+
+    python scripts/analysis/lint_invariants.py            # whole tree
+    python scripts/analysis/lint_invariants.py --list     # checks
+    python scripts/analysis/lint_invariants.py --only no-bare-print
+    python scripts/analysis/lint_invariants.py --root /some/tree
+
+Exit status 1 if any finding; findings print as ``path:line: [check]
+message``. Line-level exemptions:
+
+    # lint: allow-wallclock    — wall-clock call is intentional
+    # noqa: BLE001             — broad except is a designed seam
+    # noqa                     — unused-import / generic exemption
+
+Every check runs against a ``Repo`` snapshot (parsed ASTs + raw
+sources), so the negative tests in tests/test_analysis.py can point the
+same checks at a synthesized tree and prove each one actually fires.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover - PyYAML ships with the repo deps
+    yaml = None
+
+
+# --------------------------------------------------------------------------
+# Repo snapshot
+
+
+@dataclass
+class PyFile:
+    path: str            # repo-relative, forward slashes
+    source: str
+    tree: ast.AST
+    lines: List[str] = dc_field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.lines = self.source.splitlines()
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Repo:
+    """Parsed view of the tree the checks run against."""
+
+    def __init__(self, root: str,
+                 packages: Sequence[str] = ("llmq_tpu", "tests")) -> None:
+        self.root = os.path.abspath(root)
+        self.files: List[PyFile] = []
+        self.errors: List[str] = []
+        for pkg in packages:
+            base = os.path.join(self.root, pkg)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+                    try:
+                        with open(full, "r", encoding="utf-8") as f:
+                            src = f.read()
+                        self.files.append(PyFile(rel, src, ast.parse(src)))
+                    except (OSError, SyntaxError) as e:
+                        self.errors.append(f"{rel}: unparseable: {e}")
+
+    def get(self, rel: str) -> Optional[PyFile]:
+        for pf in self.files:
+            if pf.path == rel:
+                return pf
+        return None
+
+    def read_text(self, rel: str) -> Optional[str]:
+        full = os.path.join(self.root, rel)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    check: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+def _exempt(pf: PyFile, lineno: int, marker: str) -> bool:
+    """True if ``marker`` appears in a comment on the line or the line
+    directly above (for markers that don't fit the statement line)."""
+    return marker in pf.line(lineno) or marker in pf.line(lineno - 1)
+
+
+# --------------------------------------------------------------------------
+# Checks — one invariant per class
+
+
+class Check:
+    name = "base"
+    description = ""
+
+    def run(self, repo: Repo) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LabelContractCheck(Check):
+    """Every metric label list passed to Gauge/Counter/Histogram must
+    use only labels declared in metrics/registry.py LABEL_CONTRACT —
+    the contract tests/test_metrics_cardinality.py verifies at runtime,
+    enforced statically so an undeclared label fails before any test
+    constructs the family."""
+
+    name = "label-contract"
+    description = "metric labels must be declared in LABEL_CONTRACT"
+    REGISTRY = "llmq_tpu/metrics/registry.py"
+    METRIC_TYPES = {"Gauge", "Counter", "Histogram", "Summary"}
+
+    def _contract_keys(self, pf: PyFile) -> Optional[Set[str]]:
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "LABEL_CONTRACT"
+                    and isinstance(node.value, ast.Dict)):
+                keys = set()
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.add(k.value)
+                return keys
+        return None
+
+    @staticmethod
+    def _literal_labels(node: ast.AST) -> Optional[List[str]]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            out = []
+            for elt in node.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.append(elt.value)
+                else:
+                    return None
+            return out
+        return None
+
+    def run(self, repo: Repo) -> List[Finding]:
+        pf = repo.get(self.REGISTRY)
+        if pf is None:
+            return [Finding(self.REGISTRY, 0, self.name,
+                            "metrics registry not found")]
+        contract = self._contract_keys(pf)
+        if contract is None:
+            return [Finding(self.REGISTRY, 0, self.name,
+                            "LABEL_CONTRACT dict literal not found")]
+        findings: List[Finding] = []
+        # Metric families are constructed only in the registry module
+        # (guarded below): resolve simple `labels = [...]` assignments
+        # function-locally, then check every constructor call.
+        for reg_file in repo.files:
+            if not reg_file.path.startswith("llmq_tpu/"):
+                continue
+            assigns: Dict[Tuple[int, str], List[str]] = {}
+            for node in ast.walk(reg_file.tree):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    lits = self._literal_labels(node.value)
+                    if lits is not None:
+                        assigns[(0, node.targets[0].id)] = lits
+            for node in ast.walk(reg_file.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in self.METRIC_TYPES):
+                    continue
+                label_arg: Optional[ast.AST] = None
+                if len(node.args) >= 3:
+                    label_arg = node.args[2]
+                for kw in node.keywords:
+                    if kw.arg == "labelnames":
+                        label_arg = kw.value
+                if label_arg is None:
+                    continue
+                labels = self._literal_labels(label_arg)
+                if labels is None and isinstance(label_arg, ast.Name):
+                    labels = assigns.get((0, label_arg.id))
+                if labels is None:
+                    findings.append(Finding(
+                        reg_file.path, node.lineno, self.name,
+                        "could not statically resolve the label list for "
+                        "this metric — use a list literal or a "
+                        "module/function-level `labels = [...]`"))
+                    continue
+                for lab in labels:
+                    if lab not in contract:
+                        findings.append(Finding(
+                            reg_file.path, node.lineno, self.name,
+                            f"label {lab!r} is not declared in "
+                            f"LABEL_CONTRACT (metrics/registry.py)"))
+        return findings
+
+
+class ConfigParityCheck(Check):
+    """Every field of every dataclass reachable from core/config.py's
+    Config must appear in configs/config.yaml (at its exact dotted
+    path) AND be mentioned in docs/configuration.md — a new knob cannot
+    ship undocumented or outside the canonical config."""
+
+    name = "config-parity"
+    description = "config fields must appear in configs/config.yaml + docs"
+    CONFIG = "llmq_tpu/core/config.py"
+    YAML = "configs/config.yaml"
+    DOCS = "docs/configuration.md"
+
+    def _dataclass_fields(self, pf: PyFile) -> Dict[str, List[Tuple[str, str]]]:
+        """class name -> [(field, annotation-source)] for @dataclass
+        classes (plus the names of their @property defs, marked)."""
+        out: Dict[str, List[Tuple[str, str]]] = {}
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = any(
+                (isinstance(d, ast.Name) and d.id == "dataclass")
+                or (isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                    and d.func.id == "dataclass")
+                for d in node.decorator_list)
+            if not is_dc:
+                continue
+            fields: List[Tuple[str, str]] = []
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    fields.append((stmt.target.id,
+                                   ast.unparse(stmt.annotation)))
+            out[node.name] = fields
+        return out
+
+    def _walk_paths(self, classes: Dict[str, List[Tuple[str, str]]],
+                    cls: str, prefix: List[str],
+                    seen: Set[str]) -> List[Tuple[str, Optional[str]]]:
+        """[(dotted path, element-class-or-None)] — element-class set
+        for ``List[SomeConfig]`` fields (checked per-item)."""
+        out: List[Tuple[str, Optional[str]]] = []
+        if cls in seen:
+            return out
+        seen = seen | {cls}
+        for fname, ann in classes.get(cls, []):
+            path = prefix + [fname]
+            m = re.fullmatch(r"List\[(\w+)\]", ann)
+            if ann in classes:
+                out += self._walk_paths(classes, ann, path, seen)
+            elif m and m.group(1) in classes:
+                out.append((".".join(path), m.group(1)))
+            else:
+                out.append((".".join(path), None))
+        return out
+
+    @staticmethod
+    def _yaml_lookup(data: object, path: str) -> Tuple[bool, object]:
+        cur = data
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return False, None
+            cur = cur[part]
+        return True, cur
+
+    def run(self, repo: Repo) -> List[Finding]:
+        pf = repo.get(self.CONFIG)
+        yaml_text = repo.read_text(self.YAML)
+        docs = repo.read_text(self.DOCS)
+        missing_inputs = [
+            Finding(p, 0, self.name, "required input missing")
+            for p, present in ((self.CONFIG, pf is not None),
+                               (self.YAML, yaml_text is not None),
+                               (self.DOCS, docs is not None))
+            if not present]
+        if yaml is None:
+            # Never silently skip: a "clean" report with config parity
+            # unchecked is exactly the drift this check exists to block.
+            missing_inputs.append(Finding(
+                self.YAML, 0, self.name,
+                "PyYAML not importable — config parity cannot be "
+                "verified in this environment"))
+        if missing_inputs:
+            return missing_inputs
+        assert pf is not None and yaml_text is not None and docs is not None
+        classes = self._dataclass_fields(pf)
+        if "Config" not in classes:
+            return [Finding(self.CONFIG, 0, self.name,
+                            "root Config dataclass not found")]
+        data = yaml.safe_load(yaml_text) or {}
+        findings: List[Finding] = []
+        for path, elem_cls in self._walk_paths(classes, "Config", [], set()):
+            present, value = self._yaml_lookup(data, path)
+            if not present:
+                findings.append(Finding(
+                    self.YAML, 0, self.name,
+                    f"config field {path!r} missing from canonical YAML"))
+            elif elem_cls is not None and isinstance(value, list):
+                elem_fields = [f for f, _ in classes.get(elem_cls, [])]
+                for ef in elem_fields:
+                    if not any(isinstance(item, dict) and ef in item
+                               for item in value):
+                        findings.append(Finding(
+                            self.YAML, 0, self.name,
+                            f"{path!r} items never set {ef!r} "
+                            f"({elem_cls} field)"))
+            leaf = path.split(".")[-1]
+            if not re.search(rf"\b{re.escape(leaf)}\b", docs):
+                findings.append(Finding(
+                    self.DOCS, 0, self.name,
+                    f"config field {path!r} not mentioned in docs "
+                    f"(expected the word {leaf!r})"))
+        return findings
+
+
+class OffSwitchCheck(Check):
+    """Every subsystem config block must carry a hard off-switch: an
+    ``enabled`` field (or property). Core-infrastructure blocks that
+    are not feature subsystems are allowlisted BY NAME — a new config
+    block is treated as a subsystem until someone consciously adds it
+    to the allowlist."""
+
+    name = "off-switch"
+    description = "subsystem config blocks must define `enabled`"
+    CONFIG = "llmq_tpu/core/config.py"
+    #: Structural/core blocks that have no meaningful "off" state.
+    CORE_INFRA = {
+        "Config", "ServerConfig", "PersistenceConfig", "QueueConfig",
+        "QueueLevelConfig", "WorkerConfig", "RetryConfig",
+        "SchedulerConfig", "ResourceSchedulerConfig", "LoadBalancerConfig",
+        "ConversationConfig", "LoggingConfig", "ModelConfig",
+        "ExecutorConfig", "TPUConfig", "TenantClassConfig",
+    }
+
+    def run(self, repo: Repo) -> List[Finding]:
+        pf = repo.get(self.CONFIG)
+        if pf is None:
+            return [Finding(self.CONFIG, 0, self.name,
+                            "core/config.py not found")]
+        findings: List[Finding] = []
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = any(
+                (isinstance(d, ast.Name) and d.id == "dataclass")
+                or (isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                    and d.func.id == "dataclass")
+                for d in node.decorator_list)
+            if not is_dc or node.name in self.CORE_INFRA:
+                continue
+            has_enabled = False
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and stmt.target.id == "enabled"):
+                    has_enabled = True
+                if (isinstance(stmt, ast.FunctionDef)
+                        and stmt.name == "enabled"
+                        and any(isinstance(d, ast.Name) and d.id == "property"
+                                for d in stmt.decorator_list)):
+                    has_enabled = True
+            if not has_enabled:
+                findings.append(Finding(
+                    pf.path, node.lineno, self.name,
+                    f"subsystem block {node.name} has no `enabled` "
+                    f"hard off-switch (add one, or allowlist the class "
+                    f"in OffSwitchCheck.CORE_INFRA if it is core "
+                    f"infrastructure)"))
+        return findings
+
+
+class ClockDisciplineCheck(Check):
+    """Modules that import the injectable Clock (core/clock.py) must
+    not also call ``time.time()`` / ``time.monotonic()`` directly —
+    mixed time sources make FakeClock tests subtly wrong. Intentional
+    wall-clock reads carry ``# lint: allow-wallclock`` on the line (or
+    the line above) with a reason."""
+
+    name = "clock-discipline"
+    description = "no time.time()/time.monotonic() where Clock is in scope"
+    MARKER = "lint: allow-wallclock"
+    BANNED = {"time", "monotonic"}
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            if (not pf.path.startswith("llmq_tpu/")
+                    or pf.path.endswith("core/clock.py")):
+                continue
+            time_aliases: Set[str] = set()
+            imports_clock = False
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name == "time":
+                            time_aliases.add(alias.asname or "time")
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module and node.module.endswith("core.clock"):
+                        imports_clock = True
+            if not imports_clock or not time_aliases:
+                continue
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.BANNED
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in time_aliases):
+                    continue
+                if _exempt(pf, node.lineno, self.MARKER):
+                    continue
+                findings.append(Finding(
+                    pf.path, node.lineno, self.name,
+                    f"{node.func.value.id}.{node.func.attr}() in a module "
+                    f"that imports Clock — inject/use the clock, or mark "
+                    f"`# {self.MARKER}` with a reason"))
+        return findings
+
+
+class NoBarePrintCheck(Check):
+    """Library code logs through utils/logging; print bypasses the
+    structured stream. In tests/ the only legitimate prints are the
+    parent<->child stdout protocol of embedded subprocess scripts,
+    which must pass flush=True (same rule the previous grep lint
+    enforced — now structural instead of line-regex)."""
+
+    name = "no-bare-print"
+    description = "no print() in llmq_tpu/; tests/ prints need flush=True"
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            in_lib = pf.path.startswith("llmq_tpu/")
+            in_tests = pf.path.startswith("tests/")
+            if not (in_lib or in_tests):
+                continue
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    continue
+                if in_tests and any(
+                        kw.arg == "flush"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in node.keywords):
+                    continue
+                where = ("use utils/logging" if in_lib else
+                         "assert on outputs (only flushed "
+                         "subprocess-protocol prints are exempt)")
+                findings.append(Finding(pf.path, node.lineno, self.name,
+                                        f"bare print() — {where}"))
+        return findings
+
+
+class SwallowedExceptionCheck(Check):
+    """``except BaseException`` (or a bare ``except:``) that does not
+    re-raise swallows KeyboardInterrupt/SystemExit and the chaos
+    plane's injected crashes. Designed seams (worker retry boundary,
+    supervisor, interpreter-teardown guards) mark the except line with
+    ``# noqa: BLE001`` and a reason."""
+
+    name = "swallowed-base-exception"
+    description = "except BaseException must re-raise or be noqa: BLE001"
+    MARKER = "BLE001"
+
+    @staticmethod
+    def _is_base_exception(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except:
+        if isinstance(t, ast.Name) and t.id == "BaseException":
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(isinstance(e, ast.Name) and e.id == "BaseException"
+                       for e in t.elts)
+        return False
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            if not pf.path.startswith("llmq_tpu/"):
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_base_exception(node):
+                    continue
+                if self._reraises(node):
+                    continue
+                if _exempt(pf, node.lineno, self.MARKER):
+                    continue
+                findings.append(Finding(
+                    pf.path, node.lineno, self.name,
+                    "except BaseException without re-raise — swallows "
+                    "KeyboardInterrupt/chaos crashes; re-raise or mark "
+                    "`# noqa: BLE001` with a reason"))
+        return findings
+
+
+class UnusedImportCheck(Check):
+    """Imported names that are never referenced (ruff F401 analogue,
+    available offline). ``# noqa`` on the import line exempts
+    re-exports; ``from x import *`` and __future__ are skipped."""
+
+    name = "unused-import"
+    description = "imports must be used (or carry # noqa)"
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            used: Set[str] = set()
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    used.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    root = node
+                    while isinstance(root, ast.Attribute):
+                        root = root.value
+                    if isinstance(root, ast.Name):
+                        used.add(root.id)
+                elif (isinstance(node, ast.Constant)
+                      and isinstance(node.value, str)):
+                    used.add(node.value)   # __all__ entries, doc refs
+            for node in ast.walk(pf.tree):
+                names: List[Tuple[str, str]] = []
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        bound = alias.asname or alias.name.split(".")[0]
+                        names.append((alias.name, bound))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "__future__":
+                        continue
+                    for alias in node.names:
+                        if alias.name == "*":
+                            continue
+                        bound = alias.asname or alias.name
+                        names.append((alias.name, bound))
+                else:
+                    continue
+                if "noqa" in pf.line(node.lineno):
+                    continue
+                for orig, bound in names:
+                    if bound not in used:
+                        findings.append(Finding(
+                            pf.path, node.lineno, self.name,
+                            f"{orig!r} imported but unused"))
+        return findings
+
+
+class MutableDefaultCheck(Check):
+    """Mutable default arguments (ruff B006 analogue): a list/dict/set
+    literal or constructor as a parameter default is shared across
+    calls — the classic aliasing bug."""
+
+    name = "mutable-default"
+    description = "no mutable default arguments"
+    _CTORS = {"list", "dict", "set"}
+
+    def _is_mutable(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in self._CTORS):
+            return True
+        return False
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for default in (list(node.args.defaults)
+                                + [d for d in node.args.kw_defaults if d]):
+                    if self._is_mutable(default):
+                        findings.append(Finding(
+                            pf.path, default.lineno, self.name,
+                            f"mutable default argument in {node.name}() — "
+                            f"use None + in-body initialization"))
+        return findings
+
+
+class UnusedVariableCheck(Check):
+    """Conservative unused-local check (ruff F841-lite): a simple
+    ``name = expr`` whose name is never read anywhere in the enclosing
+    function. Underscore-prefixed names, tuple unpacking, augmented
+    assignment and functions using locals()/eval/exec are skipped, so
+    every finding is a true positive."""
+
+    name = "unused-variable"
+    description = "local variables must be read (or start with _)"
+
+    def run(self, repo: Repo) -> List[Finding]:
+        findings: List[Finding] = []
+        for pf in repo.files:
+            for func in ast.walk(pf.tree):
+                if not isinstance(func, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                dynamic = any(
+                    isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in ("locals", "eval", "exec", "vars")
+                    for n in ast.walk(func))
+                if dynamic:
+                    continue
+                loads: Set[str] = set()
+                stores: Dict[str, List[int]] = {}
+                for n in ast.walk(func):
+                    if isinstance(n, ast.Name):
+                        if isinstance(n.ctx, ast.Load):
+                            loads.add(n.id)
+                        elif isinstance(n.ctx, ast.Del):
+                            loads.add(n.id)
+                # Only direct, simple assignments in the function BODY —
+                # not nested functions (own scope, collected on their own
+                # walk) and not nested class bodies (class attributes are
+                # read through the class, e.g. BaseHTTPRequestHandler's
+                # protocol_version, so "never loaded here" proves nothing).
+                nested = {id(x) for inner in ast.walk(func)
+                          if isinstance(inner, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef,
+                                                ast.Lambda, ast.ClassDef))
+                          and inner is not func
+                          for x in ast.walk(inner)}
+                for n in ast.walk(func):
+                    if id(n) in nested or not isinstance(n, ast.Assign):
+                        continue
+                    if len(n.targets) != 1:
+                        continue
+                    t = n.targets[0]
+                    if not isinstance(t, ast.Name) or t.id.startswith("_"):
+                        continue
+                    stores.setdefault(t.id, []).append(n.lineno)
+                # Nonlocal/global escape the local scope.
+                escaped: Set[str] = set()
+                for n in ast.walk(func):
+                    if isinstance(n, (ast.Global, ast.Nonlocal)):
+                        escaped.update(n.names)
+                for name, linenos in stores.items():
+                    if name in loads or name in escaped:
+                        continue
+                    if "noqa" in pf.line(linenos[0]):
+                        continue
+                    findings.append(Finding(
+                        pf.path, linenos[0], self.name,
+                        f"local {name!r} assigned but never read in "
+                        f"{func.name}()"))
+        return findings
+
+
+ALL_CHECKS: List[Check] = [
+    LabelContractCheck(),
+    ConfigParityCheck(),
+    OffSwitchCheck(),
+    ClockDisciplineCheck(),
+    NoBarePrintCheck(),
+    SwallowedExceptionCheck(),
+    UnusedImportCheck(),
+    MutableDefaultCheck(),
+    UnusedVariableCheck(),
+]
+
+
+def run_checks(root: str,
+               only: Optional[Iterable[str]] = None) -> List[Finding]:
+    repo = Repo(root)
+    wanted = set(only) if only else None
+    findings: List[Finding] = [
+        Finding(p.split(":")[0], 0, "parse", e) for p, e in
+        ((err, err) for err in repo.errors)]
+    for check in ALL_CHECKS:
+        if wanted is not None and check.name not in wanted:
+            continue
+        findings.extend(check.run(repo))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    ap.add_argument("--only", default="",
+                    help="comma-separated check names")
+    ap.add_argument("--list", action="store_true", dest="list_checks")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for check in ALL_CHECKS:
+            sys.stdout.write(f"{check.name:26s} {check.description}\n")
+        return 0
+
+    only = [s.strip() for s in args.only.split(",") if s.strip()] or None
+    if only:
+        known = {c.name for c in ALL_CHECKS}
+        bad = [o for o in only if o not in known]
+        if bad:
+            ap.error(f"unknown checks: {bad}; known: {sorted(known)}")
+    findings = run_checks(args.root, only)
+    for f in findings:
+        sys.stdout.write(f"{f}\n")
+    if findings:
+        sys.stdout.write(f"lint_invariants: {len(findings)} finding(s)\n")
+        return 1
+    sys.stdout.write("lint_invariants: clean\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""mypy ratchet runner (docs/analysis.md).
+
+Runs mypy over ``llmq_tpu/`` with mypy.ini and applies the ratchet in
+``scripts/analysis/mypy_ratchet.txt``:
+
+- an error in a module NOT listed in the ratchet fails the run — new
+  and already-clean code must stay clean;
+- errors under a ratchet prefix are tolerated (counted and printed);
+- a ratchet prefix that produced ZERO errors is stale: the runner
+  nudges to delete it (``--strict-stale`` turns the nudge into a
+  failure), so the ratchet only ever shrinks and type coverage only
+  grows.
+
+mypy is an optional tool: if it is not importable (e.g. this image
+bakes the JAX toolchain but no type checker), the runner prints a skip
+notice and exits 0 — CI installs mypy in the analysis lane, so the
+check is enforced where it matters without making local development
+depend on it.
+
+Usage:
+    python scripts/analysis/run_mypy.py
+    python scripts/analysis/run_mypy.py --strict-stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+RATCHET = os.path.join(REPO, "scripts", "analysis", "mypy_ratchet.txt")
+
+_ERROR_RE = re.compile(r"^(?P<path>[^:\s][^:]*\.py):(?P<line>\d+):.* error:")
+
+
+def load_ratchet(path: str = RATCHET) -> List[str]:
+    prefixes: List[str] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if line and not line.startswith("#"):
+                prefixes.append(line.replace(os.sep, "/"))
+    return prefixes
+
+
+def classify(errors: Sequence[Tuple[str, str]],
+             ratchet: Sequence[str]) -> Tuple[List[str], Dict[str, int]]:
+    """Split mypy error lines into (hard failures, per-prefix ratcheted
+    counts)."""
+    hard: List[str] = []
+    ratcheted: Dict[str, int] = {p: 0 for p in ratchet}
+    for path, line in errors:
+        norm = path.replace(os.sep, "/")
+        for prefix in ratchet:
+            if norm.startswith(prefix):
+                ratcheted[prefix] += 1
+                break
+        else:
+            hard.append(line)
+    return hard, ratcheted
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--strict-stale", action="store_true",
+                    help="fail on ratchet entries that are now clean")
+    ap.add_argument("--ratchet", default=RATCHET)
+    args = ap.parse_args(argv)
+
+    if importlib.util.find_spec("mypy") is None:
+        sys.stderr.write(
+            "run_mypy: mypy not installed in this environment — skipping "
+            "(the CI analysis lane installs and enforces it)\n")
+        return 0
+
+    ratchet = load_ratchet(args.ratchet)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file", "mypy.ini",
+         "llmq_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+
+    errors: List[Tuple[str, str]] = []
+    for line in out.splitlines():
+        m = _ERROR_RE.match(line)
+        if m:
+            errors.append((m.group("path"), line))
+
+    hard, ratcheted = classify(errors, ratchet)
+    active = {p: n for p, n in ratcheted.items() if n}
+    stale = [p for p, n in ratcheted.items() if n == 0]
+
+    for line in hard:
+        sys.stdout.write(line + "\n")
+    if active:
+        sys.stdout.write("ratcheted (tolerated, burn these down):\n")
+        for p, n in sorted(active.items()):
+            sys.stdout.write(f"  {p:32s} {n} error(s)\n")
+    if stale:
+        verb = "FAIL" if args.strict_stale else "note"
+        sys.stdout.write(
+            f"{verb}: ratchet entries now clean — delete them from "
+            f"{os.path.relpath(args.ratchet, REPO)} so coverage stays "
+            f"locked in: {sorted(stale)}\n")
+
+    if hard:
+        sys.stdout.write(
+            f"run_mypy: FAILED — {len(hard)} error(s) outside the "
+            f"ratchet\n")
+        return 1
+    if stale and args.strict_stale:
+        return 1
+    sys.stdout.write(
+        f"run_mypy: OK ({len(errors)} ratcheted error(s), "
+        f"{len(stale)} stale entr{'y' if len(stale) == 1 else 'ies'})\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Native sanitizer harness runner (docs/analysis.md).
+
+Builds the asan/ubsan/tsan variants of the C++ queue core into
+``native/build/`` (never touching the production ``.so``), runs the
+concurrent stress driver under each, then drives the asan/ubsan
+``.so`` variants through the REAL Python queue suites
+(tests/test_priority_queue.py + tests/test_tenancy.py) via the
+``LLMQ_NATIVE_LIB`` loader override — so the exact op sequences the
+fair-dequeue and tombstone paths issue in production run under
+instrumentation, not just the synthetic stress mix.
+
+tsan is stress-only: a tsan-instrumented ``.so`` cannot be reliably
+loaded into an uninstrumented CPython (the tsan runtime must own every
+thread from process start), so thread-race coverage comes from the
+native stress driver, which exercises the same mutex-protected core
+from 8 host threads.
+
+Usage:
+    python scripts/analysis/run_sanitizers.py                # everything
+    python scripts/analysis/run_sanitizers.py --sanitizers asan
+    python scripts/analysis/run_sanitizers.py --skip-pytest  # stress only
+    python scripts/analysis/run_sanitizers.py --threads 4 --ops 100000
+
+Exit status is nonzero on any build failure, stress failure, sanitizer
+report, or pytest failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE = os.path.join(REPO, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+#: Python queue suites run against the instrumented .so — the suites
+#: that exercise push/pop/pop_handle/expire_older_than/discard through
+#: every MultiLevelQueue seam (including the fair-dequeue layer).
+PYTEST_SUITES = [
+    os.path.join("tests", "test_priority_queue.py"),
+    os.path.join("tests", "test_tenancy.py"),
+]
+
+SANITIZERS = ("asan", "ubsan", "tsan")
+
+
+def run(cmd: List[str], env: Dict[str, str], label: str) -> bool:
+    sys.stderr.write(f"--- {label}: {' '.join(cmd)}\n")
+    sys.stderr.flush()
+    proc = subprocess.run(cmd, env=env, cwd=REPO)
+    if proc.returncode != 0:
+        sys.stderr.write(f"--- {label}: FAILED (rc={proc.returncode})\n")
+        return False
+    return True
+
+
+def libasan_path() -> str:
+    """The asan runtime to LD_PRELOAD so an uninstrumented CPython can
+    host the instrumented .so (gcc links the .so against the shared
+    runtime, but the runtime must be first in the link order)."""
+    gxx = os.environ.get("CXX", "g++")
+    out = subprocess.run([gxx, "-print-file-name=libasan.so"],
+                         capture_output=True, text=True, check=True)
+    return out.stdout.strip()
+
+
+def sanitizer_env(san: str, host_python: bool = False) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LLMQ_NATIVE_LIB"] = os.path.join(BUILD, f"_libmlq_{san}.so")
+    if san == "asan" and host_python:
+        env["LD_PRELOAD"] = libasan_path()
+        # CPython intentionally leaks interned/static allocations at
+        # exit; leak detection on the host interpreter is pure noise.
+        # Everything else (UAF, overflow, double-free) stays fatal.
+        # The native stress binary does NOT get this: LeakSanitizer
+        # stays fully enabled there, so mlq.cpp leaks fail the run.
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+    if san == "ubsan":
+        env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    return env
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sanitizers", default="asan,ubsan,tsan",
+                    help="comma-separated subset of asan,ubsan,tsan")
+    ap.add_argument("--threads", type=int, default=8,
+                    help="stress driver threads (acceptance floor: 4)")
+    ap.add_argument("--ops", type=int, default=120000,
+                    help="stress ops per thread (acceptance floor: 100k)")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--skip-pytest", action="store_true",
+                    help="stress drivers only (no Python suite runs)")
+    args = ap.parse_args()
+
+    wanted = [s.strip() for s in args.sanitizers.split(",") if s.strip()]
+    unknown = [s for s in wanted if s not in SANITIZERS]
+    if unknown:
+        ap.error(f"unknown sanitizers: {unknown}; valid: {SANITIZERS}")
+    if shutil.which(os.environ.get("CXX", "g++")) is None:
+        sys.stderr.write("run_sanitizers: no C++ compiler on PATH — "
+                         "skipping (native core is optional)\n")
+        return 0
+
+    failures: List[str] = []
+    base_env = dict(os.environ)
+
+    if not run(["make", "-C", NATIVE] + wanted, base_env, "build"):
+        return 1
+
+    for san in wanted:
+        stress = os.path.join(BUILD, f"stress_{san}")
+        if not run([stress, str(args.threads), str(args.ops),
+                    str(args.seed)],
+                   sanitizer_env(san), f"stress-{san}"):
+            failures.append(f"stress-{san}")
+
+    if not args.skip_pytest:
+        for san in wanted:
+            if san == "tsan":
+                sys.stderr.write(
+                    "--- pytest-tsan: skipped (tsan runtime cannot be "
+                    "injected into an uninstrumented CPython; stress "
+                    "driver covers thread races)\n")
+                continue
+            cmd = [sys.executable, "-m", "pytest", "-q",
+                   "-p", "no:cacheprovider"] + PYTEST_SUITES
+            if not run(cmd, sanitizer_env(san, host_python=True),
+                       f"pytest-{san}"):
+                failures.append(f"pytest-{san}")
+
+    if failures:
+        sys.stderr.write(f"run_sanitizers: FAILED: {failures}\n")
+        return 1
+    sys.stderr.write("run_sanitizers: all clean\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

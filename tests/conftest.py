@@ -45,6 +45,18 @@ except (AttributeError, ValueError, OSError):
     # plain enable() above still covers hard crashes.
     pass
 
+# Lockdep opt-in (docs/analysis.md): LLMQ_LOCKDEP=1 instruments every
+# threading.Lock/RLock created from here on with the lock-order-graph
+# tracker. MUST install before any llmq_tpu import below — module-level
+# locks (native loader, metrics registry, usage ledger singletons) are
+# created at import time and would otherwise go untracked. Violations
+# (potential-deadlock cycles, held-lock blocking calls) fail the run at
+# session end via pytest_sessionfinish.
+from llmq_tpu.analysis import lockdep  # noqa: E402
+
+if lockdep.enabled_by_env():
+    lockdep.install()
+
 import pytest  # noqa: E402
 
 from llmq_tpu.core.clock import FakeClock  # noqa: E402
@@ -63,6 +75,24 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "requires_tpu" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail a lockdep-instrumented run on any recorded violation —
+    after every test, so the report names all cycles at once rather
+    than whichever test tripped first."""
+    if not lockdep.is_installed():
+        return
+    v = lockdep.violations()
+    if v:
+        rep = getattr(session.config, "_lockdep_reported", False)
+        if not rep:
+            session.config._lockdep_reported = True
+            import sys as _sys
+            _sys.stderr.write(
+                f"\nLOCKDEP: {len(v)} violation(s) recorded during this "
+                "run:\n\n" + "\n\n".join(v) + "\n")
+        session.exitstatus = 3
 
 
 @pytest.fixture

@@ -6,7 +6,6 @@ sleep-based."""
 
 import threading
 
-from llmq_tpu.core.clock import FakeClock
 from llmq_tpu.core.types import Message
 from llmq_tpu.queueing.delayed_queue import DelayedQueue
 

@@ -8,13 +8,11 @@ from __future__ import annotations
 
 import time
 
-import numpy as np
 import pytest
 
 import jax
 
 from llmq_tpu.core.config import SloConfig, default_config
-from llmq_tpu.core.types import Priority
 from llmq_tpu.engine import ByteTokenizer, EchoExecutor, InferenceEngine
 from llmq_tpu.engine.engine import GenRequest
 from llmq_tpu.engine.kv_allocator import PageAllocator

@@ -8,7 +8,6 @@ The engine replaces the reference's simulated LLM processing
 
 import threading
 
-import numpy as np
 import pytest
 
 from llmq_tpu.core.clock import FakeClock

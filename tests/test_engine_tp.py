@@ -10,8 +10,6 @@ path), plus the builder's ``tpu.mesh_shape`` wiring.
 """
 
 import jax
-import numpy as np
-import pytest
 
 from llmq_tpu.core.types import Priority
 from llmq_tpu.engine.engine import GenRequest, InferenceEngine

@@ -10,8 +10,6 @@ from llmq_tpu.core.types import Message, Priority
 from llmq_tpu.queueing.factory import (
     QueueFactory,
     QueueType,
-    long_content_rule,
-    vip_rule,
 )
 
 
@@ -69,7 +67,7 @@ class TestManagers:
 
 class TestWorkers:
     def test_create_workers_and_stats(self, factory):
-        m = factory.create_queue_manager("w", start_background=False)
+        factory.create_queue_manager("w", start_background=False)
         workers = factory.create_workers("w", 2, lambda ctx, msg: None,
                                          start=False)
         assert len(workers) == 2

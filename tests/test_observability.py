@@ -544,7 +544,6 @@ class TestOverheadGuard:
         worker = Worker("obs-bench", mgr, eng.process_fn)
         worker.start()
         try:
-            done = []
             n = 40
             t0 = time.perf_counter()
             for i in range(n):

@@ -242,8 +242,8 @@ class TestEngineIntegration:
     def test_disabled_is_hard_off(self):
         eng = make_echo_engine()                     # default: no cache
         assert eng._prefix_cache is None
-        h1 = eng.submit(GenRequest(id="a", prompt="abcd",
-                                   conversation_id="c"))
+        eng.submit(GenRequest(id="a", prompt="abcd",
+                              conversation_id="c"))
         eng.run_until_idle()
         assert "prefix_cache" not in eng.get_stats()
         assert eng.prefix_hits == 0 and eng.prefix_misses == 0

@@ -6,7 +6,6 @@ tombstone expiry, requeue accounting."""
 
 import pytest
 
-from llmq_tpu.core.clock import FakeClock
 from llmq_tpu.core.errors import (
     QueueEmptyError,
     QueueFullError,

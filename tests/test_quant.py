@@ -251,8 +251,6 @@ class TestInt8KVCache:
             # one decode step on top of the prefilled history
             last = toks[:, -1]
             pos = jnp.full((2,), T, jnp.int32)
-            page_of = bt[jnp.arange(2), pos // 16]
-            slot_of = pos % 16
             dlogits, cache = forward_decode(params, cfg, last, pos, cache,
                                             bt)
             outs[name] = (np.asarray(logits), np.asarray(dlogits))

@@ -7,12 +7,11 @@ affinity across replicas, per-endpoint load feedback, and failover when
 an engine dies (health state machine → UNHEALTHY → traffic moves).
 """
 
-import threading
 
 import pytest
 
 from llmq_tpu.core.config import LoadBalancerConfig
-from llmq_tpu.core.types import Message, MessageStatus, Priority
+from llmq_tpu.core.types import Message, MessageStatus
 from llmq_tpu.engine.engine import InferenceEngine
 from llmq_tpu.engine.executor import EchoExecutor
 from llmq_tpu.engine.tokenizer import ByteTokenizer

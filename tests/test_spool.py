@@ -8,12 +8,10 @@ at-least-once guarantees (claim mutual exclusion, stale-claim
 reclamation, poison parking) and the App-level gateway↔consumer wiring.
 """
 
-import json
 import os
 import threading
 import time
 
-import pytest
 
 from llmq_tpu.core.types import Message, MessageStatus, Priority
 from llmq_tpu.queueing.queue_manager import QueueManager

@@ -17,8 +17,6 @@ The load-bearing contracts:
 
 from __future__ import annotations
 
-import threading
-import time
 from types import SimpleNamespace
 
 import pytest
@@ -30,7 +28,6 @@ from llmq_tpu.core.config import (Config, OverloadConfig,
 from llmq_tpu.core.errors import QueueEmptyError
 from llmq_tpu.core.types import Message, Priority
 from llmq_tpu.queueing.queue_manager import QueueManager
-from llmq_tpu import tenancy
 from llmq_tpu.tenancy import (FairScheduler, TenantRegistry,
                               configure_tenancy, estimate_tokens,
                               get_tenant_registry, reset_tenancy,

@@ -39,7 +39,7 @@ class TestQueueWAL:
         for i in range(4):
             qm.push_message(mk(f"m{i}"))
         a = qm.pop_message("normal")
-        b = qm.pop_message("normal")
+        qm.pop_message("normal")           # "b": popped, never completed
         qm.complete_message(a, 0.1)        # finished → gone
         # b popped but never completed → crash → must redeliver
         qm.stop()
@@ -183,8 +183,8 @@ class TestQueueWAL:
         for i in range(5):
             qm.push_message(mk(f"m{i}"))
         # Two popped-but-unfinished on top of a full queue → 7 live.
-        a = qm.pop_message("normal")
-        b = qm.pop_message("normal")
+        qm.pop_message("normal")
+        qm.pop_message("normal")
         qm.push_message(mk("m5"))
         qm.push_message(mk("m6"))
         qm.stop()

@@ -9,7 +9,6 @@ drift loud.
 """
 
 import numpy as np
-import pytest
 
 import jax
 

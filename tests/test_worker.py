@@ -7,9 +7,7 @@ reference leaves dangling: retry → delayed queue, exhaustion → DLQ."""
 
 import threading
 
-import pytest
 
-from llmq_tpu.core.clock import FakeClock
 from llmq_tpu.core.types import Message, MessageStatus, Priority
 from llmq_tpu.queueing.dead_letter_queue import DeadLetterQueue
 from llmq_tpu.queueing.delayed_queue import DelayedQueue
