@@ -895,9 +895,20 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     if os.environ.get("LLMQ_BENCH_PREFIX_CACHE", "1") != "0":
         from llmq_tpu.core.config import PrefixCacheConfig
         pc = PrefixCacheConfig(enabled=True)
+    # Async decode pipeline ON by default (LLMQ_BENCH_ASYNC_PIPELINE=0
+    # for the synchronous A/B run): double-buffered chunk dispatch +
+    # off-path completions — the RTT-tax eraser (ROADMAP item 4). Per
+    # rate point the overlap ratio and depth histogram land in
+    # point["pipeline"].
+    ap = None
+    if os.environ.get("LLMQ_BENCH_ASYNC_PIPELINE", "1") != "0":
+        from llmq_tpu.core.config import AsyncPipelineConfig
+        ap = AsyncPipelineConfig(
+            enabled=True,
+            depth=int(os.environ.get("LLMQ_BENCH_PIPELINE_DEPTH", "2")))
     engine = InferenceEngine(ex, tok, enable_metrics=False,
                              max_decode_steps=32, prefix_cache=pc,
-                             mixed_batch=mb)
+                             mixed_batch=mb, async_pipeline=ap)
     engine.start()
 
     # Discarded warm burst: the first requests after a fresh executor
@@ -938,6 +949,7 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         # fold in the warm burst and every earlier rate point.
         dev0_steps = ((engine.get_stats().get("device") or {})
                       .get("steps") or {})
+        pipe0 = dict(engine.pipeline_depth_hist)
         # Usage-ledger snapshot for per-phase goodput/waste attribution
         # (observability/usage.py — the ledger is cumulative, so the
         # point reports deltas like every other counter here).
@@ -1026,7 +1038,8 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         # MFU as of the phase end, PER-PHASE step-decomposition means
         # (cumulative-total deltas against the phase-start snapshot),
         # and the HBM/pool snapshot.
-        dev = engine.get_stats().get("device") or {}
+        eng_stats = engine.get_stats()
+        dev = eng_stats.get("device") or {}
         steps = dev.get("steps") or {}
 
         def _phase_mean(leg: str):
@@ -1047,8 +1060,34 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                             - dev0_steps.get("count", 0)),
             "step_mean_ms": {
                 k: _phase_mean(k)
-                for k in ("dispatch_ms", "device_ms", "readback_ms")},
+                for k in ("dispatch_ms", "device_ms", "readback_ms",
+                          "overlapped_ms")},
         }
+        # Async-pipeline attribution (docs/performance.md "Async
+        # pipeline"): THIS phase's overlap ratio (from the overlapped/
+        # device step-time deltas) and the pipeline-depth histogram of
+        # chunks dispatched during the phase.
+        pipe_stats = eng_stats.get("pipeline")
+        if pipe_stats is not None:
+            def _leg_delta(leg: str) -> float:
+                cur = steps.get(leg) or {}
+                pre = dev0_steps.get(leg) or {}
+                return (cur.get("total_ms", 0.0)
+                        - pre.get("total_ms", 0.0))
+
+            d_over = max(0.0, _leg_delta("overlapped_ms"))
+            d_dev = max(0.0, _leg_delta("device_ms"))
+            hist = {}
+            for k, v in engine.pipeline_depth_hist.items():
+                dv = v - pipe0.get(k, 0)
+                if dv > 0:
+                    hist[str(k)] = dv
+            point["pipeline"] = {
+                "depth": pipe_stats["depth"],
+                "overlap_ratio": (round(d_over / (d_over + d_dev), 4)
+                                  if d_over + d_dev > 0 else 0.0),
+                "depth_hist": hist,
+            }
         # Per-phase usage attribution: device-second and waste deltas
         # against the phase-start snapshot, plus the rolling goodput as
         # of phase end (fed by the recorder flush — drive it here, the
@@ -1253,6 +1292,15 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     out["sla_curve"] = curve
     out["realtime_p99_gate_ms"] = p99_gate_ms
     out["max_rate_realtime_p99_ok"] = max_ok_rate
+    # RTT-tax milestone tracking (ROADMAP item 4: → ≈0): the headline
+    # point already carries realtime_p99_minus_2rtt_ms (computed per
+    # point and copied into ``out`` above); surface the pipeline
+    # attribution next to it and log both so every run's artifact and
+    # console carry the milestone.
+    out["pipeline"] = (headline or {}).get("pipeline")
+    log(f"[poisson-tpu] headline realtime_p99_minus_2rtt_ms="
+        f"{out.get('realtime_p99_minus_2rtt_ms')} "
+        f"pipeline={out['pipeline']}")
     if sweep_capped:
         out["max_rate_ladder_capped"] = True
     log(f"[poisson-tpu] max rate with realtime p99 <= "

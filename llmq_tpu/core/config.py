@@ -440,6 +440,43 @@ class OverloadConfig:
 
 
 @dataclass
+class AsyncPipelineConfig:
+    """Asynchronously pipelined decode hot path (docs/performance.md
+    "Async pipeline"): the engine keeps up to ``depth`` dispatched
+    decode/mixed chunks in flight (double-buffered ``_InflightChunk``s
+    chained through device-resident carries), token readback runs on a
+    dedicated fetch thread that batches the device→host transfer
+    across all rows, and sampling bookkeeping / detokenization / SSE
+    framing move onto a small completion executor — so the engine
+    thread's only job between dispatches is packing the next chunk.
+    ``enabled: false`` is a hard off-switch: the engine schedules
+    exactly as it did before the subsystem existed (single in-flight
+    chunk + one speculative dispatch, all completions inline on the
+    engine thread, the echo executor fully synchronous)."""
+    enabled: bool = True
+    #: Dispatched-but-unreconciled chunks the engine may keep in
+    #: flight. 2 = classic double buffering (the next chunk's compute
+    #: hides the current chunk's readback); 1 disables speculation
+    #: entirely (reconcile every chunk — strictly tighter than the
+    #: off-switch, which keeps one speculative dispatch).
+    depth: int = 2
+    #: Threads on the completion executor. Jobs for one request always
+    #: land on the same worker, so per-request token/finish order is
+    #: preserved at any worker count.
+    completion_workers: int = 1
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.depth <= 4:
+            raise ValueError(
+                f"async_pipeline.depth must be in [1, 4] "
+                f"(got {self.depth})")
+        if not 1 <= self.completion_workers <= 8:
+            raise ValueError(
+                f"async_pipeline.completion_workers must be in [1, 8] "
+                f"(got {self.completion_workers})")
+
+
+@dataclass
 class SupervisorConfig:
     """Engine crash supervisor (engine/supervisor.py,
     docs/robustness.md): detects a dead engine thread, fails the
@@ -565,6 +602,8 @@ class ExecutorConfig:
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     mixed_batch: MixedBatchConfig = field(default_factory=MixedBatchConfig)
+    async_pipeline: AsyncPipelineConfig = field(
+        default_factory=AsyncPipelineConfig)
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
 
 

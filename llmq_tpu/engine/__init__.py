@@ -10,7 +10,8 @@ from llmq_tpu.engine.engine import (
     GenResult,
     InferenceEngine,
 )
-from llmq_tpu.engine.executor import EchoExecutor, ExecutorSpec, JaxExecutor
+from llmq_tpu.engine.executor import (EchoExecutor, ExecutorSpec,
+                                      HostStaging, JaxExecutor)
 from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.supervisor import EngineSupervisor
 from llmq_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer, get_tokenizer
@@ -25,6 +26,7 @@ __all__ = [
     "GenRequest",
     "GenResult",
     "HFTokenizer",
+    "HostStaging",
     "InferenceEngine",
     "JaxExecutor",
     "PageAllocator",

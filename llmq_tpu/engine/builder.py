@@ -33,6 +33,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
 
     mixed = getattr(ex, "mixed_batch", None)
     mixed_on = bool(getattr(mixed, "enabled", False))
+    pipe = getattr(ex, "async_pipeline", None)
+    pipe_on = bool(getattr(pipe, "enabled", False))
     # Executor-side mixed geometry: S slice rows × T tokens (the
     # compiled program's shapes). Disabled → S = 0 → no mixed program
     # is built, and the engine keeps the exact unfused scheduling.
@@ -49,7 +51,11 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             eos_id=tokenizer.eos_id,
             chunk_size=ex.decode_chunk,
             mixed_prefill_slices=mixed_slices,
-            mixed_slice_tokens=mixed_slice_tokens)
+            mixed_slice_tokens=mixed_slice_tokens,
+            # Futures-returning chunk API (docs/performance.md "Async
+            # pipeline"): only exposed when the pipeline is on, so the
+            # off-switch keeps the exact synchronous echo scheduling.
+            async_chunks=pipe_on)
     elif ex.backend == "jax":
         import jax
         import jax.numpy as jnp
@@ -148,11 +154,13 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         enable_metrics=metrics_on,
         tier_max_wait=tier_max_wait,
         prefix_cache=getattr(ex, "prefix_cache", None),
-        mixed_batch=mixed)
+        mixed_batch=mixed,
+        async_pipeline=pipe)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
-             "prefix_cache=%s mixed_batch=%s)",
+             "prefix_cache=%s mixed_batch=%s async_pipeline=%s)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
              "on" if getattr(ex.prefix_cache, "enabled", False) else "off",
              (f"on(budget={mixed.prefill_token_budget}"
-              f"x{mixed_slices})" if mixed_on else "off"))
+              f"x{mixed_slices})" if mixed_on else "off"),
+             (f"on(depth={pipe.depth})" if pipe_on else "off"))
     return engine

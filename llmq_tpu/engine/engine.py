@@ -40,9 +40,11 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import queue
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -51,7 +53,7 @@ import numpy as np
 from llmq_tpu import chaos
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.types import Message, Priority
-from llmq_tpu.engine.executor import Executor
+from llmq_tpu.engine.executor import Executor, HostStaging
 from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from llmq_tpu.metrics.registry import get_metrics
@@ -210,6 +212,13 @@ class GenHandle:
         return self._cancelled.is_set()
 
     def _finish(self, result: GenResult) -> None:
+        # First writer wins: the zero-duplicate completion contract. A
+        # crash recovery racing a queued completion-executor finish for
+        # the same handle must not overwrite the delivered result (the
+        # recovery drains the pool first, but the guard makes the
+        # contract hold even if a future caller forgets to).
+        if self._done.is_set():
+            return
         self.result = result
         self.finished_at = time.perf_counter()
         self._done.set()
@@ -232,7 +241,7 @@ class _Sequence:
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
                  "first_handle", "eff_prio", "arrival", "prefix_match",
                  "reuse_counted", "mixed_pending", "pf_tokens_run",
-                 "usage")
+                 "usage", "pending_emit")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -296,6 +305,12 @@ class _Sequence:
         #: every measured chunk; None with the plane disabled (the hard
         #: off-switch — every charge point is then one None check).
         self.usage: Optional[RequestUsage] = None
+        #: Tokens committed but not yet delivered to the streaming
+        #: callback (async-pipeline completion offload): the engine
+        #: thread appends here and flushes one batch job per chunk to
+        #: the completion executor — SSE framing never runs on the
+        #: step-dispatch path. Always empty with the pipeline off.
+        self.pending_emit: List[int] = []
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -313,10 +328,11 @@ class _InflightChunk:
     handle.fetch() returns (decode tokens, slice first-tokens)."""
 
     __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf",
-                 "dispatch_s")
+                 "dispatch_s", "dispatched_at")
 
     def __init__(self, handle, seqs, budgets, pf=None,
-                 dispatch_s: float = 0.0) -> None:
+                 dispatch_s: float = 0.0,
+                 dispatched_at: float = 0.0) -> None:
         self.handle = handle
         self.seqs = seqs          # List[Optional[_Sequence]], len B
         self.budgets = budgets    # np.ndarray (B,) int32
@@ -326,6 +342,77 @@ class _InflightChunk:
         #: "dispatch" leg of the step decomposition; the device/readback
         #: legs are measured at fetch (observability/device.py).
         self.dispatch_s = dispatch_s
+        #: perf_counter when the program was handed to the device queue
+        #: — the start of this chunk's device span. The telemetry's
+        #: overlap attribution (timed_fetch) needs it to split the span
+        #: into novel device time vs time that overlapped other
+        #: in-flight chunks (the pipelining win).
+        self.dispatched_at = dispatched_at
+
+
+class _CompletionPool:
+    """Off-path completion executor (docs/performance.md "Async
+    pipeline"): token-stream callbacks, trace recording,
+    detokenization and handle completion run here, so the engine
+    thread's only job between dispatches is packing the next chunk.
+    Jobs for one request key always land on the same worker (FIFO per
+    worker), so per-request token order — and tokens-before-done — are
+    preserved at any worker count."""
+
+    def __init__(self, workers: int, name: str) -> None:
+        self._qs: List[queue.Queue] = [queue.Queue()
+                                       for _ in range(max(1, workers))]
+        self._threads: List[threading.Thread] = []
+        for i, q in enumerate(self._qs):
+            t = threading.Thread(target=self._loop, args=(q,),
+                                 name=f"completion-{i}-{name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self, q: queue.Queue) -> None:
+        while True:
+            fn = q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a broken consumer must
+                # not kill the worker; the next request's jobs still run
+                log.exception("completion job failed")
+
+    def submit(self, key: str, fn) -> None:
+        self._qs[hash(key) % len(self._qs)].put(fn)
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Barrier: returns True once every job submitted before the
+        call has run (crash recovery's completion-dedup depends on it —
+        a queued finish must land before handles are re-failed). A
+        timeout (a worker wedged inside a blocking stream callback) is
+        returned AND logged loudly — the caller's dedup guarantee is
+        weakened and that must not be silent."""
+        evs = []
+        for q in self._qs:
+            ev = threading.Event()
+            q.put(ev.set)
+            evs.append(ev)
+        ok = True
+        for ev in evs:
+            if not ev.wait(timeout):
+                ok = False
+        if not ok:
+            log.error(
+                "completion pool drain timed out after %.1fs — a queued "
+                "completion may land after the barrier (duplicate-"
+                "delivery risk if this was a crash-recovery drain)",
+                timeout)
+        return ok
+
+    def stop(self) -> None:
+        for q in self._qs:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5.0)
 
 
 @dataclass
@@ -363,6 +450,7 @@ class InferenceEngine:
         tier_max_wait: Optional[Dict[Priority, float]] = None,
         prefix_cache=None,
         mixed_batch=None,
+        async_pipeline=None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -465,9 +553,44 @@ class InferenceEngine:
         #: the prefix cache is enabled.
         self._conv_evicted_tokens: Dict[str, List[List[int]]] = {}
         self._order = itertools.count()
-        #: In-flight decode chunk (pipelined path): dispatched but not
-        #: yet fetched. See _decode_once / _dispatch_speculative.
-        self._chunk_inflight: Optional[_InflightChunk] = None
+        #: Async decode pipeline (docs/performance.md "Async
+        #: pipeline"). ``async_pipeline`` accepts a
+        #: core.config.AsyncPipelineConfig or anything with its fields;
+        #: None/disabled keeps the exact pre-pipeline scheduling (one
+        #: in-flight chunk + one speculative dispatch, completions
+        #: inline) — the config's hard off-switch.
+        self._pipe_cfg = (async_pipeline
+                          if async_pipeline is not None
+                          and getattr(async_pipeline, "enabled", False)
+                          else None)
+        #: Bound on dispatched-but-unreconciled chunks. The off-switch
+        #: value 2 IS today's scheduling: one in flight plus at most
+        #: one speculative dispatch per step.
+        self._pipe_depth = (max(1, min(4, int(getattr(
+            self._pipe_cfg, "depth", 2))))
+            if self._pipe_cfg is not None else 2)
+        #: Completion executor lanes (0 = completions inline on the
+        #: engine thread, the pre-pipeline behavior).
+        self._completion_workers = (max(1, min(8, int(getattr(
+            self._pipe_cfg, "completion_workers", 1))))
+            if self._pipe_cfg is not None else 0)
+        self._completion: Optional[_CompletionPool] = None
+        #: Dispatched-but-unfetched chunks, oldest first (pipelined
+        #: path). See _decode_once / _dispatch_speculative / step().
+        self._inflight: "deque[_InflightChunk]" = deque()
+        #: Chunks dispatched at each pipeline occupancy (depth AFTER
+        #: the dispatch) — the bench's depth histogram. Keys are
+        #: PREALLOCATED for every reachable depth so the engine thread
+        #: only ever updates existing entries: stats scrapes and bench
+        #: delta loops iterate this dict lock-free from other threads,
+        #: and a first-seen-key insert could resize it mid-iteration.
+        self.pipeline_depth_hist: Dict[int, int] = {
+            d: 0 for d in range(1, 5)}
+        #: Host staging buffers for chunk assembly (tokens/positions/
+        #: block tables/temps) — per-dispatch np.zeros churn killer.
+        #: Budgets stay freshly allocated: the _InflightChunk reads
+        #: them again at process time, after the ring may have rotated.
+        self._staging = HostStaging(ring=max(8, self._pipe_depth + 4))
         self._mu = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -690,6 +813,22 @@ class InferenceEngine:
             q.put(None)
         for t, q in lanes.values():
             t.join(timeout=10.0)
+        # Completion executor last: fetch lanes can no longer enqueue
+        # work, so a drain here sees every queued job. Recreated lazily
+        # if the engine restarts.
+        comp, self._completion = self._completion, None
+        if comp is not None:
+            comp.drain()
+            comp.stop()
+        # Executor-side worker teardown (the echo backend's simulated
+        # device-queue thread); optional seam, lazily re-created if the
+        # executor is driven again.
+        close = getattr(self.executor, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                log.exception("executor close failed for %s", self.name)
 
     @property
     def running(self) -> bool:
@@ -718,10 +857,17 @@ class InferenceEngine:
         restart-ready afterwards (``start()`` brings up a fresh loop).
         """
         assert not self.running, "recover_after_crash needs a dead loop"
-        # The in-flight chunk's device output is unreachable (the dead
-        # loop owned its reconcile); drop the snapshot — its sequences
-        # are failed below and their retry re-prefills from scratch.
-        self._chunk_inflight = None
+        # Every in-flight chunk's device output is unreachable (the
+        # dead loop owned their reconciles); drop the snapshots — their
+        # sequences are failed below and the retry re-prefills from
+        # scratch. With the async pipeline this can be TWO (depth)
+        # chunks, not one; the invariants are the same per chunk.
+        self._inflight.clear()
+        # Completion-dedup barrier: a finish the dead loop already
+        # queued on the completion executor must LAND before the
+        # handle.done checks below — otherwise a completed request
+        # would also be re-failed into the retry path (duplicate).
+        self._drain_completions()
         with self._mu:
             inbox, self._inbox = self._inbox, []
         pending = [s for (_, _, s) in self._pending]
@@ -778,6 +924,13 @@ class InferenceEngine:
                           "supervisor recovery takes over", self.name)
             raise
 
+    @property
+    def _chunk_inflight(self) -> Optional[_InflightChunk]:
+        """Newest in-flight chunk (None with the pipeline empty) — the
+        pre-deque name, kept for tests/instrumentation that probe
+        whether dispatched work is outstanding."""
+        return self._inflight[-1] if self._inflight else None
+
     # -- core step -----------------------------------------------------------
 
     def step(self) -> bool:
@@ -785,16 +938,19 @@ class InferenceEngine:
         Single stepper at a time — either the engine thread or a
         test/bench driving it synchronously.
 
-        Pipelined decode (async-capable executors): a dispatched chunk
-        is reconciled here FIRST — and when no scheduling work is
-        waiting, the NEXT chunk is dispatched from the device-carried
-        end state *before* fetching this one's tokens, so the fetch's
-        host↔device round-trip overlaps the next chunk's compute and
-        the device never idles between chunks. Any scheduling work
-        (arrivals, pending admissions, prefills, cancellations) forces
-        the reconcile-then-fresh-dispatch path, which rebuilds the
-        batch from host state — so scheduling only ever acts on
-        reconciled bookkeeping."""
+        Pipelined decode (async-capable executors): the oldest
+        dispatched chunk is reconciled here — and when no scheduling
+        work is waiting, the pipeline is first FILLED to
+        ``async_pipeline.depth`` chunks dispatched from the
+        device-carried end state *before* fetching the oldest one's
+        tokens, so the fetch's host↔device round-trip overlaps the
+        in-flight chunks' compute and the device never idles between
+        chunks. Any scheduling work (arrivals, pending admissions,
+        prefills, cancellations) stops speculation and drains the
+        pipeline one chunk per step down to the
+        reconcile-then-fresh-dispatch path, which rebuilds the batch
+        from host state — so scheduling only ever acts on reconciled
+        bookkeeping."""
         # Chaos seam (docs/robustness.md): kind "error" is absorbed by
         # the loop's except (one lost round); kind "crash" is a
         # BaseException that sails past it and KILLS the engine thread
@@ -809,8 +965,7 @@ class InferenceEngine:
         # one is in flight; see _admit/_alloc_pages).
         admitted = self._admit()       # free slots only while in flight
         prefilled = self._advance_prefill()
-        if self._chunk_inflight is not None:
-            infl = self._chunk_inflight
+        if self._inflight:
             # Speculate BEFORE the blocking resolve: a just-admitted
             # sequence must still hold an UNRESOLVED first_handle at
             # the speculation decision so it enters via the join plan
@@ -819,22 +974,36 @@ class InferenceEngine:
             # speculation → its tokens wait a whole extra reconcile
             # cycle (measured: realtime tail_ms p99 +190 ms when the
             # fetch-wait servicing made resolves early).
-            nxt = None
-            if (not self._has_scheduling_work()
-                    and not self._geometry_changed(infl)
-                    and not self._mixed_work_waiting()):
+            #
+            # Pipeline fill: keep dispatching from the newest chunk's
+            # device-carried end state until ``depth`` chunks are in
+            # flight (depth 2 = the classic double buffer and the
+            # pre-pipeline scheduling: at most ONE speculative dispatch
+            # per step, since one chunk is always reconciled below).
+            while (len(self._inflight) < self._pipe_depth
+                   and not self._has_scheduling_work()
+                   and not self._geometry_changed(self._inflight[-1])
+                   and not self._mixed_work_waiting()):
                 # Mixed batching: pending prefill slices must ride the
                 # next host-assembled MIXED chunk — a speculative
                 # decode-only chunk would push them out a full cycle.
-                nxt = self._dispatch_speculative(infl)
+                nxt = self._dispatch_speculative(self._inflight[-1])
+                if nxt is None:
+                    break
+                self._inflight.append(nxt)
             # Resolve AFTER dispatch, BEFORE processing: join rows'
             # first tokens must commit before any of their chunk rows
             # do (the chunk being processed may contain join rows from
             # the previous cycle).
             self._resolve_prefills()
+            # Reconcile the OLDEST chunk. It stays in the deque while
+            # its fetch completes: the servicing admissions inside
+            # _process_chunk consult ``self._inflight`` to defer
+            # preemption/shedding, and its rows are still untouchable.
+            infl = self._inflight[0]
             self._process_chunk(infl)
-            self._chunk_inflight = nxt
-            if nxt is None:
+            self._inflight.popleft()
+            if not self._inflight:
                 # Reconciled: re-run admission NOW, when preemption and
                 # page-shedding are legal again (the pre-reconcile
                 # _admit above skips them while rows are in flight —
@@ -876,9 +1045,14 @@ class InferenceEngine:
             if not did:
                 with self._mu:
                     idle = (not self._inbox and not self._pending
-                            and self._chunk_inflight is None
+                            and not self._inflight
                             and all(s is None for s in self._slots))
                 if idle:
+                    # Flush queued completion jobs so a caller checking
+                    # handle.result right after idle sees every finish
+                    # delivered (the async-pipeline offload otherwise
+                    # races synchronous test/bench drivers).
+                    self._drain_completions()
                     return
         raise RuntimeError("engine did not go idle")
 
@@ -990,7 +1164,7 @@ class InferenceEngine:
                 continue
             slot = self._free_slot()
             if (slot is None and self.preemption_enabled
-                    and self._chunk_inflight is None):
+                    and not self._inflight):
                 # No preemption while a chunk is in flight: the victim's
                 # rows are still decoding on device and its host-side
                 # position bookkeeping would go stale. The pending
@@ -1159,7 +1333,7 @@ class InferenceEngine:
                 continue
             if self._reclaim_pending_pages(requester):
                 continue
-            if self._chunk_inflight is not None:
+            if self._inflight:
                 # Page-shedding a decoding row would free pages the
                 # in-flight chunk is still writing; defer to the next
                 # reconcile (the unadmitted request blocks speculation).
@@ -1472,6 +1646,7 @@ class InferenceEngine:
                 _prefetch(handle)
             else:
                 self._complete_prefill(seq, first)
+                self._flush_emits(seq)
         return True
 
     def _resolve_prefills(self) -> bool:
@@ -1509,6 +1684,7 @@ class InferenceEngine:
                 continue
             seq.first_handle = None
             self._complete_prefill(seq, int(first))
+            self._flush_emits(seq)   # first token must not wait a chunk
         return True
 
     def _note_prefill_dispatch(self, tokens: int, host_seconds: float,
@@ -1772,7 +1948,13 @@ class InferenceEngine:
             seq = infl.seqs[slot]
             if seq is None or seq.slot != slot or not seq.prefilled:
                 continue
-            prev_b = int(infl.budgets[slot])
+            # Bounds accumulate over EVERY in-flight chunk this row
+            # rides (pipeline depth > 2 chains several): the row's
+            # host-side pos/generated were last reconciled before the
+            # OLDEST chunk, so each unreconciled chunk may consume its
+            # full budget before this one runs.
+            prev_b = sum(int(c.budgets[slot]) for c in self._inflight
+                         if c.seqs[slot] is seq)
             gen_upper = len(seq.generated) + prev_b
             pos_upper = seq.pos + prev_b
             limit = seq.req.max_new_tokens or self.max_decode_steps
@@ -1784,11 +1966,12 @@ class InferenceEngine:
             plan.append((seq, slot, b, max(0, need)))
         # Joining rows: same eligibility as _decode_once's join path
         # (final prefill dispatched, not a rebuild/resume), minus rows
-        # already snapshotted into the in-flight chunk.
+        # already snapshotted into ANY in-flight chunk.
         join_plan = []   # (seq, slot, budget, pages_needed)
         for slot in range(B):
             seq = self._slots[slot]
-            if (seq is None or seq is infl.seqs[slot] or seq.prefilled
+            if (seq is None or seq.prefilled
+                    or any(c.seqs[slot] is seq for c in self._inflight)
                     or seq.first_handle is None or seq.todo_ids
                     or seq.todo_resume is not None or seq.todo_rebuild
                     or seq.handle.cancelled):
@@ -1805,9 +1988,10 @@ class InferenceEngine:
                 > self.allocator.available()):
             return None     # would require shedding → reconcile
         t_asm = time.perf_counter()   # step decomposition: dispatch leg
-        budgets = np.zeros(B, np.int32)
-        block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
-        temps = np.zeros(B, np.float32)
+        budgets = np.zeros(B, np.int32)   # read again at process time
+        block_tables = self._staging.take(
+            "chunk.bt", (B, self.spec.max_pages_per_seq), np.int32)
+        temps = self._staging.take("chunk.temp", (B,), np.float32)
         for seq, slot, b, need in plan + join_plan:
             if need > 0:
                 pages = self.allocator.alloc(need)
@@ -1829,13 +2013,17 @@ class InferenceEngine:
             handle = self.executor.decode_chunk_start(
                 None, None, block_tables, temps, budgets,
                 carry=infl.handle, overrides=overrides)
-        dispatch_s = time.perf_counter() - t_asm
+        now = time.perf_counter()
+        dispatch_s = now - t_asm
         _prefetch(getattr(handle, "out", None))
         self.steps += 1
+        self._note_dispatch_depth(len(self._inflight) + 1)
+        # (caller appends the chunk after return)
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
         infl_next = _InflightChunk(handle, seqs, budgets,
-                                   dispatch_s=dispatch_s)
+                                   dispatch_s=dispatch_s,
+                                   dispatched_at=now)
         self._start_fetch(infl_next)
         return infl_next
 
@@ -1884,11 +2072,14 @@ class InferenceEngine:
     def _start_fetch(self, infl: _InflightChunk) -> None:
         """Hand the chunk's blocking fetch to the fetcher thread (the
         D2H transfer itself was already queued by ``_prefetch`` at
-        dispatch). The timed wrapper splits the wait into device
-        execute vs token readback — the fetch box then holds
-        ``(result, device_s, readback_s)``."""
+        dispatch; the fetch itself is ONE batched transfer across all
+        rows — never per-row blocking). The timed wrapper splits the
+        wait into device execute vs token readback and attributes the
+        pipeline overlap against the dispatch timestamp — the fetch box
+        then holds ``(result, device_s, readback_s, overlapped_s)``."""
         infl.fetch_box = self._offload_fetch(
-            lambda: self._telemetry.timed_fetch(infl.handle))
+            lambda: self._telemetry.timed_fetch(
+                infl.handle, dispatched_at=infl.dispatched_at))
 
     def _fetch_loop(self, q) -> None:
         while True:
@@ -1932,6 +2123,79 @@ class InferenceEngine:
             # is attributable in the artifact itself.
             self.stall_events += 1
             self.stall_ms_total += (time.perf_counter() - t0) * 1e3
+
+    # -- completion offload (docs/performance.md "Async pipeline") ------------
+
+    def _completion_pool(self) -> _CompletionPool:
+        """Lazy singleton (same pattern as the fetch lanes): only
+        engines that actually run the async pipeline spawn completion
+        threads. Single-caller discipline: created from the engine
+        thread (or the supervisor's recovery path with the loop dead),
+        never concurrently."""
+        p = self._completion
+        if p is None:
+            p = self._completion = _CompletionPool(
+                self._completion_workers, self.name)
+        return p
+
+    def _drain_completions(self) -> bool:
+        if self._completion is not None:
+            return self._completion.drain()
+        return True
+
+    def _note_dispatch_depth(self, depth: int) -> None:
+        """One chunk dispatched at pipeline occupancy ``depth``. Plain
+        indexed increment on preallocated keys (1..4): stats scrapes
+        iterate the dict lock-free, so a first-seen-key resize must be
+        impossible — an out-of-range depth is a bug and fails loudly
+        here instead of silently growing the dict."""
+        self.pipeline_depth_hist[depth] += 1
+
+    def _flush_emits(self, seq: _Sequence) -> None:
+        """Ship a sequence's buffered token callbacks to the completion
+        executor as ONE batch job (chunk-granularity, same cadence the
+        callbacks already documented). No-op with nothing buffered —
+        callable liberally after every commit site."""
+        if not seq.pending_emit:
+            return
+        toks, seq.pending_emit = seq.pending_emit, []
+        handle = seq.handle
+        req_id = seq.req.id
+
+        def emit() -> None:
+            cb = handle._on_token
+            if cb is None:
+                return
+            for t in toks:
+                try:
+                    cb(t)
+                except Exception:  # noqa: BLE001 — broken stream consumer
+                    log.exception("on_token callback failed; detaching",
+                                  extra={"fields": {"request_id": req_id}})
+                    handle._on_token = None
+                    return
+
+        self._completion_pool().submit(req_id, emit)
+
+    def _deliver_finish(self, seq: _Sequence, reason: str,
+                        error: str) -> None:
+        """Completion-executor tail of ``_finish``: trace recording,
+        detokenization and the handle completion — everything that
+        talks to the request, nothing that touches engine state. Runs
+        AFTER the sequence's last token batch (same request key, FIFO
+        worker), so streams always see tokens, then done."""
+        try:
+            self._record_trace(seq, reason)
+        except Exception:  # noqa: BLE001 — tracing must not block delivery
+            log.exception("trace record failed for %s", seq.req.id)
+        res = GenResult(
+            text=self.tokenizer.decode(seq.generated),
+            tokens=list(seq.generated),
+            prompt_tokens=len(seq.prompt_ids),
+            cached_tokens=seq.cached_len,
+            finish_reason=reason,
+            error=error)
+        seq.handle._finish(res)
 
     # -- usage attribution (observability/usage.py) ---------------------------
 
@@ -1996,8 +2260,9 @@ class InferenceEngine:
         if box is None:
             t0 = time.perf_counter()
             with self._prof.span("engine.chunk_fetch"):
-                out, device_s, readback_s = \
-                    self._telemetry.timed_fetch(infl.handle)
+                out, device_s, readback_s, overlapped_s = \
+                    self._telemetry.timed_fetch(
+                        infl.handle, dispatched_at=infl.dispatched_at)
             dt = time.perf_counter() - t0
             if dt > 5.0:          # same stall threshold as _service_while
                 log.warning("blocking chunk fetch stalled %.1f s "
@@ -2009,7 +2274,7 @@ class InferenceEngine:
                 self._service_while(box["ev"])
             if box["err"] is not None:
                 raise box["err"]
-            out, device_s, readback_s = box["out"]
+            out, device_s, readback_s, overlapped_s = box["out"]
         pf_first = None
         if infl.pf is not None:
             out, pf_first = out      # mixed chunk: (decode, slice firsts)
@@ -2033,10 +2298,12 @@ class InferenceEngine:
             if seq is None or seq.slot != slot:
                 continue    # finished while the chunk was in flight
             self._commit_row(seq, out[slot], int(infl.budgets[slot]))
+            self._flush_emits(seq)
         if infl.pf is not None:
             self._finish_mixed_prefills(infl.pf, pf_first)
         self._telemetry.note_step(infl.dispatch_s, device_s, readback_s,
-                                  self.tokens_generated_total - tok0)
+                                  self.tokens_generated_total - tok0,
+                                  overlapped_s=overlapped_s)
         self._set_gauges()
 
     def _budget_chunk_rows(self, chunk: int, rows) -> Dict[int, int]:
@@ -2150,11 +2417,13 @@ class InferenceEngine:
             return False
 
         t_asm = time.perf_counter()   # step decomposition: dispatch leg
-        tokens = np.zeros(B, np.int32)
-        positions = np.zeros(B, np.int32)
-        block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
-        temps = np.zeros(B, np.float32)
-        budgets = np.zeros(B, np.int32)
+        st = self._staging            # per-dispatch alloc churn killer
+        tokens = st.take("chunk.tok", (B,), np.int32)
+        positions = st.take("chunk.pos", (B,), np.int32)
+        block_tables = st.take("chunk.bt",
+                               (B, self.spec.max_pages_per_seq), np.int32)
+        temps = st.take("chunk.temp", (B,), np.float32)
+        budgets = np.zeros(B, np.int32)   # read again at process time
         overrides = []
         for seq in active + joining:
             i = seq.slot
@@ -2176,14 +2445,18 @@ class InferenceEngine:
                                  joined=len(joining)):
                 handle = start_fn(tokens, positions, block_tables, temps,
                                   budgets, overrides=overrides)
-            dispatch_s = time.perf_counter() - t_asm
+            now = time.perf_counter()
+            dispatch_s = now - t_asm
             _prefetch(getattr(handle, "out", None))
             seqs = [None] * B
             for seq in active + joining:
                 seqs[seq.slot] = seq
-            self._chunk_inflight = _InflightChunk(handle, seqs, budgets,
-                                                  dispatch_s=dispatch_s)
-            self._start_fetch(self._chunk_inflight)
+            infl = _InflightChunk(handle, seqs, budgets,
+                                  dispatch_s=dispatch_s,
+                                  dispatched_at=now)
+            self._inflight.append(infl)
+            self._note_dispatch_depth(len(self._inflight))
+            self._start_fetch(infl)
             self.steps += 1
             if self._metrics:
                 self._metrics.decode_steps.labels(self.name).inc()
@@ -2212,6 +2485,7 @@ class InferenceEngine:
         tok0 = self.tokens_generated_total
         for seq in active:
             self._commit_row(seq, out[seq.slot], int(budgets[seq.slot]))
+            self._flush_emits(seq)
         self._telemetry.note_step(t_call - t_asm, t_done - t_call,
                                   t_rb - t_done,
                                   self.tokens_generated_total - tok0)
@@ -2285,11 +2559,13 @@ class InferenceEngine:
             return self._decode_once()
 
         t_asm = time.perf_counter()   # step decomposition: dispatch leg
-        tokens = np.zeros(B, np.int32)
-        positions = np.zeros(B, np.int32)
-        block_tables = np.zeros((B, self.spec.max_pages_per_seq), np.int32)
-        temps = np.zeros(B, np.float32)
-        budgets = np.zeros(B, np.int32)
+        st = self._staging            # per-dispatch alloc churn killer
+        tokens = st.take("chunk.tok", (B,), np.int32)
+        positions = st.take("chunk.pos", (B,), np.int32)
+        block_tables = st.take("chunk.bt",
+                               (B, self.spec.max_pages_per_seq), np.int32)
+        temps = st.take("chunk.temp", (B,), np.float32)
+        budgets = np.zeros(B, np.int32)   # read again at process time
         for seq in active:
             i = seq.slot
             tokens[i] = seq.last_token
@@ -2339,10 +2615,12 @@ class InferenceEngine:
                 seqs[seq.slot] = seq
             for seq, _, _ in infl_pf:
                 seq.mixed_pending = True
-            self._chunk_inflight = _InflightChunk(handle, seqs, budgets,
-                                                  pf=infl_pf,
-                                                  dispatch_s=dispatch_s)
-            self._start_fetch(self._chunk_inflight)
+            infl = _InflightChunk(handle, seqs, budgets, pf=infl_pf,
+                                  dispatch_s=dispatch_s,
+                                  dispatched_at=time.perf_counter())
+            self._inflight.append(infl)
+            self._note_dispatch_depth(len(self._inflight))
+            self._start_fetch(infl)
             self.steps += 1
             self.mixed_steps += 1
             self.mixed_prefill_tokens_total += packed
@@ -2377,6 +2655,7 @@ class InferenceEngine:
             if seq.slot is not None:
                 self._commit_row(seq, out[seq.slot],
                                  int(budgets[seq.slot]))
+                self._flush_emits(seq)
         self._finish_mixed_prefills(infl_pf, pf_first)
         self._telemetry.note_step(t0 - t_asm, t_done - t0, t_rb - t_done,
                                   self.tokens_generated_total - tok0)
@@ -2396,6 +2675,8 @@ class InferenceEngine:
                 continue
             if final:
                 self._complete_prefill(seq, int(pf_first[i]))
+                self._flush_emits(seq)   # admission first token: no
+                #                          extra chunk of SSE latency
 
     def _commit_token(self, seq: _Sequence, nxt: int) -> None:
         if nxt == self.spec.eos_id:
@@ -2408,13 +2689,19 @@ class InferenceEngine:
         if len(seq.generated) == 1:
             handle.marks.setdefault("first_token", time.perf_counter())
         if handle._on_token is not None:
-            try:
-                handle._on_token(nxt)
-            except Exception:  # noqa: BLE001 — a broken stream consumer
-                log.exception("on_token callback failed; detaching",
-                              extra={"fields": {
-                                  "request_id": seq.req.id}})
-                handle._on_token = None
+            if self._completion_workers > 0:
+                # Async pipeline: SSE framing/streaming callbacks run
+                # on the completion executor, not the dispatch path —
+                # buffered here, flushed one batch job per chunk.
+                seq.pending_emit.append(nxt)
+            else:
+                try:
+                    handle._on_token(nxt)
+                except Exception:  # noqa: BLE001 — broken stream consumer
+                    log.exception("on_token callback failed; detaching",
+                                  extra={"fields": {
+                                      "request_id": seq.req.id}})
+                    handle._on_token = None
         if self._metrics:
             self._metrics.generated_tokens.labels(
                 self.name, seq.req.priority.tier_name).inc()
@@ -2586,6 +2873,17 @@ class InferenceEngine:
                 ok=reason in ("eos", "length"),
                 waste_reason=waste_reason or (
                     "cancelled" if reason == "cancelled" else "error"))
+        if self._completion_workers > 0:
+            # Engine state is fully released above; the request-facing
+            # tail (trace, detok, handle completion) moves off the
+            # dispatch path. Ordering: the token flush precedes the
+            # finish job on the same request key, so the stream's
+            # consumer sees every token before done.
+            self._flush_emits(seq)
+            self._completion_pool().submit(
+                seq.req.id,
+                lambda: self._deliver_finish(seq, reason, error))
+            return
         self._record_trace(seq, reason)
         res = GenResult(
             text=self.tokenizer.decode(seq.generated),
@@ -2683,6 +2981,19 @@ class InferenceEngine:
             # compile-cache state.
             "device": self._telemetry.snapshot(),
         }
+        if self._pipe_cfg is not None:
+            # Async pipeline (docs/performance.md): occupancy histogram
+            # (chunks dispatched at each in-flight depth) + the
+            # telemetry's overlap ratio — what bench.py reports as
+            # per-rate-point ``point["pipeline"]`` deltas.
+            out["pipeline"] = {
+                "depth": self._pipe_depth,
+                "completion_workers": self._completion_workers,
+                "depth_hist": {str(k): v for k, v in
+                               sorted(self.pipeline_depth_hist.items())
+                               if v},
+                "overlap_ratio": self._telemetry.overlap_ratio(),
+            }
         if self._mixed_cfg is not None:
             out["mixed_batch"] = {
                 "steps": self.mixed_steps,

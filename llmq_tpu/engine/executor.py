@@ -28,10 +28,11 @@ tokens, which bounds realtime admission latency to K decode steps.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Protocol
+from typing import Dict, List, Optional, Protocol, Tuple
 
 import numpy as np
 
@@ -39,6 +40,62 @@ from llmq_tpu.utils.logging import get_logger
 from llmq_tpu.utils.profiling import annotate
 
 log = get_logger("executor")
+
+
+class HostStaging:
+    """Preallocated, ring-rotated host staging buffers per (tag,
+    geometry) — the dispatch paths' ``np.zeros``/``np.asarray(...).copy``
+    churn killer (ISSUE 10 satellite, measured via the PR 6
+    ``step_dispatch_ms`` gauge): a dispatch takes a buffer, fills it and
+    hands it straight to ``jnp.asarray``/the program, instead of
+    allocating (and page-faulting) a fresh array per chunk.
+
+    Buffers ROTATE through a small ring rather than being reused
+    immediately: ``jax.device_put`` may alias aligned host memory
+    (zero-copy on the CPU backend), so a buffer must not be rewritten
+    while the dispatch that used it can still read it. The engine
+    bounds in-flight chunks at ``async_pipeline.depth`` (≤ 4) and
+    prefill waves at one dispatch per slot, so a ring sized past those
+    bounds guarantees the slot being rewritten belongs to a dispatch
+    that has long been consumed.
+
+    Single-writer by design: only the engine's scheduling thread takes
+    buffers (same discipline as the executor call sites themselves)."""
+
+    def __init__(self, ring: int = 8) -> None:
+        self._ring = max(2, int(ring))
+        self._bufs: Dict[Tuple, List[np.ndarray]] = {}
+        self._idx: Dict[Tuple, int] = {}
+        self._aranges: Dict[int, np.ndarray] = {}
+
+    def take(self, tag: str, shape, dtype,
+             fill: Optional[int] = 0) -> np.ndarray:
+        """Next ring buffer for ``(tag, shape, dtype)``, pre-filled with
+        ``fill`` (None skips the memset — caller overwrites fully)."""
+        key = (tag, tuple(shape) if hasattr(shape, "__len__") else (shape,),
+               np.dtype(dtype))
+        ring = self._bufs.get(key)
+        if ring is None:
+            ring = [np.empty(key[1], key[2]) for _ in range(self._ring)]
+            self._bufs[key] = ring
+            self._idx[key] = 0
+        i = self._idx[key]
+        self._idx[key] = (i + 1) % self._ring
+        buf = ring[i]
+        if fill is not None:
+            buf.fill(fill)
+        return buf
+
+    def arange(self, n: int) -> np.ndarray:
+        """Cached read-only ``np.arange(n, int32)`` template (prefill
+        position vectors are ``arange + start`` — no reason to rebuild
+        the ramp per dispatch)."""
+        a = self._aranges.get(n)
+        if a is None:
+            a = np.arange(n, dtype=np.int32)
+            a.setflags(write=False)
+            self._aranges[n] = a
+        return a
 
 
 @dataclass(frozen=True)
@@ -101,6 +158,62 @@ class Executor(Protocol):
 # -- echo ----------------------------------------------------------------------
 
 
+class _EchoOutProbe:
+    """Stands in for the device output array on the echo async path so
+    ``DeviceTelemetry.timed_fetch`` can time the simulated device
+    execution: ``block_until_ready`` waits for the device-queue thread
+    to run the program (no ``copy_to_host_async`` on purpose — the
+    engine's ``_prefetch`` treats its absence as a no-op)."""
+
+    __slots__ = ("_ev",)
+
+    def __init__(self, ev: threading.Event) -> None:
+        self._ev = ev
+
+    def block_until_ready(self) -> None:
+        self._ev.wait()
+
+
+class EchoChunkHandle:
+    """In-flight echo chunk (``async_chunks`` mode): results materialize
+    when the executor's device-queue thread runs the program. Carry
+    surface mirrors :class:`ChunkHandle` — ``_tok``/``_pos``/``_done``
+    are read by the NEXT chained program's closure, which is safe
+    because the device queue is FIFO: by the time program N+1 runs,
+    program N has completed and set them."""
+
+    __slots__ = ("out", "_ev", "_out", "_tok", "_pos", "_done",
+                 "pf_first", "_err", "_mixed")
+
+    def __init__(self, mixed: bool = False) -> None:
+        self._ev = threading.Event()
+        self.out = _EchoOutProbe(self._ev)
+        self._out = None
+        self._tok = None
+        self._pos = None
+        self._done = None
+        self.pf_first = None
+        self._err: Optional[BaseException] = None
+        self._mixed = mixed
+
+    def _set(self, out, tok, pos, done, pf_first=None) -> None:
+        self._out, self._tok, self._pos, self._done = out, tok, pos, done
+        self.pf_first = pf_first
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._err = err
+        self._ev.set()
+
+    def fetch(self):
+        self._ev.wait()
+        if self._err is not None:
+            raise self._err
+        if self._mixed:
+            return self._out, self.pf_first
+        return self._out
+
+
 class EchoExecutor:
     """Echoes the prompt: token i of the response is prompt token i; after
     the full prompt, EOS. No device, no KV reads — but the engine still
@@ -110,7 +223,9 @@ class EchoExecutor:
                  num_pages: int = 512, max_pages_per_seq: int = 32,
                  eos_id: int = 2, chunk_size: int = 1,
                  mixed_prefill_slices: int = 2,
-                 mixed_slice_tokens: int = 64) -> None:
+                 mixed_slice_tokens: int = 64,
+                 async_chunks: bool = False,
+                 step_delay_s: float = 0.0) -> None:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
         self.chunk_size = chunk_size
@@ -121,6 +236,26 @@ class EchoExecutor:
         self._slot_prompt: Dict[int, List[int]] = {}
         self._slot_end: Dict[int, int] = {}   # absolute pos after prompt
         self._mu = threading.Lock()
+        #: Async-pipeline mode (docs/performance.md "Async pipeline"):
+        #: chunks dispatch to a FIFO "device queue" thread and return
+        #: futures (EchoChunkHandle) — the same surface JaxExecutor's
+        #: decode_chunk_start gives the engine, so the pipelined engine
+        #: path runs (and is tested) without a device. Disabled, the
+        #: start entrypoints are hidden (None) and the executor is
+        #: byte-identical to the pre-pipeline synchronous one.
+        self._async_chunks = bool(async_chunks)
+        #: Simulated per-chunk device latency: 0 keeps the queue-plane
+        #: benches instant; the overlap smoke sets a couple of ms so
+        #: pipeline_overlap_ratio is deterministic, not a thread race.
+        self._step_delay_s = max(0.0, float(step_delay_s))
+        self._devq: Optional[queue.Queue] = None
+        self._dev_thread: Optional[threading.Thread] = None
+        if not self._async_chunks:
+            # Hide the futures API: the engine feature-detects
+            # decode_chunk_start/mixed_chunk_start with getattr — a
+            # None instance attribute keeps it on the sync path.
+            self.decode_chunk_start = None    # type: ignore[assignment]
+            self.mixed_chunk_start = None     # type: ignore[assignment]
 
     def _register_prefill(self, slot: int, tokens: List[int],
                           start_pos: int) -> List[int]:
@@ -161,6 +296,12 @@ class EchoExecutor:
     def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
                      block_tables: np.ndarray, temperatures: np.ndarray,
                      budgets: np.ndarray) -> np.ndarray:
+        if self._step_delay_s:
+            # Simulated device latency applies to the SYNC path too, so
+            # a pipelined-vs-synchronous A/B (the CI overlap smoke)
+            # compares against the same simulated device. 0 by default
+            # — the queue-plane benches stay instant.
+            time.sleep(self._step_delay_s)
         K = self.chunk_size
         B = self.spec.batch_size
         out = np.full((B, K), self.spec.eos_id, np.int32)
@@ -199,6 +340,142 @@ class EchoExecutor:
         out = self.decode_chunk(tokens, positions, block_tables,
                                 temperatures, budgets)
         return out, pf_first
+
+    # -- async futures API (docs/performance.md "Async pipeline") ------------
+
+    def _device_submit(self, fn, mixed: bool = False) -> "EchoChunkHandle":
+        """Enqueue one simulated device program. The single FIFO worker
+        thread mirrors a real accelerator's in-order execution stream —
+        chained carries read the PREVIOUS handle's end state, which FIFO
+        order guarantees is set by then."""
+        if self._devq is None:
+            self._devq = queue.Queue()
+            self._dev_thread = threading.Thread(
+                target=self._device_loop, args=(self._devq,),
+                name="echo-device", daemon=True)
+            self._dev_thread.start()
+        h = EchoChunkHandle(mixed=mixed)
+        self._devq.put((fn, h))
+        return h
+
+    def _device_loop(self, q: queue.Queue) -> None:
+        # The queue rides in as an argument (not re-read from self):
+        # close() nulls the attribute before posting the shutdown
+        # sentinel, and the loop must keep draining ITS queue.
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, h = item
+            try:
+                fn(h)
+            except BaseException as e:  # noqa: BLE001 — surfaced at fetch
+                h._fail(e)
+
+    def close(self) -> None:
+        """Stop the simulated device-queue thread (engine.stop() calls
+        this through the optional executor-close seam). Lazily
+        re-created if the executor dispatches again afterwards."""
+        q, self._devq = self._devq, None
+        t, self._dev_thread = self._dev_thread, None
+        if q is not None:
+            q.put(None)
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _run_chunk_async(self, tok, pos, frozen, budgets):
+        """Chunk body with the JAX program's carry semantics
+        (_decode_chunk): ``frozen`` (done_in/EOS) is a PERSISTENT latch
+        carried out; budget exhaustion only pauses the row for this
+        chunk. The sync ``decode_chunk`` keeps its original
+        budget-conflating loop untouched (identical OUT matrix; it
+        never carries state), so the off-switch path stays
+        byte-identical to the pre-pipeline code."""
+        K, B = self.chunk_size, self.spec.batch_size
+        eos = self.spec.eos_id
+        out = np.full((B, K), eos, np.int32)
+        tok = np.asarray(tok, np.int32).copy()
+        pos = np.asarray(pos, np.int32).copy()
+        frozen = np.asarray(frozen, bool).copy()
+        budgets = np.asarray(budgets, np.int32)
+        for j in range(K):
+            active = (~frozen) & (j < budgets)
+            if not active.any():
+                break           # the while_loop's early exit
+            nxt = self.decode(tok, pos, None, None)
+            out[:, j] = np.where(active, nxt, eos).astype(np.int32)
+            tok = np.where(active, nxt, tok).astype(np.int32)
+            pos = pos + active.astype(np.int32)
+            frozen = frozen | (active & (nxt == eos))
+        return out, tok, pos, frozen
+
+    def decode_chunk_start(self, tokens, positions, block_tables,
+                           temperatures, budgets,
+                           carry: Optional["EchoChunkHandle"] = None,
+                           overrides: Optional[List] = None
+                           ) -> "EchoChunkHandle":
+        """Futures-returning decode chunk (parity with
+        JaxExecutor.decode_chunk_start): dispatch returns immediately;
+        with ``carry``, tok/pos/done come from the previous chunk's end
+        state; ``overrides`` re-seed a lane (slot, first-token, pos) for
+        a same-step join. Inputs are SNAPSHOTTED at dispatch — the
+        engine's staging buffers may be rewritten before the program
+        runs."""
+        B = self.spec.batch_size
+        toks = (None if tokens is None
+                else np.asarray(tokens, np.int32).copy())
+        poss = (None if positions is None
+                else np.asarray(positions, np.int32).copy())
+        buds = np.asarray(budgets, np.int32).copy()
+        ovr = [(int(s), sc, int(p)) for s, sc, p in (overrides or ())]
+
+        def run(h: "EchoChunkHandle") -> None:
+            if self._step_delay_s:
+                time.sleep(self._step_delay_s)
+            if carry is not None:
+                tok, pos, done = carry._tok, carry._pos, carry._done
+            else:
+                tok, pos = toks, poss
+                done = np.zeros(B, bool)
+            tok = np.asarray(tok, np.int32).copy()
+            pos = np.asarray(pos, np.int32).copy()
+            done = np.asarray(done, bool).copy()
+            for slot, sc, p in ovr:
+                tok[slot] = int(np.asarray(sc))
+                pos[slot] = p
+                done[slot] = False
+            h._set(*self._run_chunk_async(tok, pos, done, buds))
+
+        return self._device_submit(run)
+
+    def mixed_chunk_start(self, tokens, positions, block_tables,
+                          temperatures, budgets,
+                          pf: List) -> "EchoChunkHandle":
+        """Futures-returning mixed chunk: slice registration happens on
+        the device-queue thread (FIFO — before any later chained
+        chunk), mirroring the fused program writing slice KV inside the
+        same dispatch."""
+        toks = np.asarray(tokens, np.int32).copy()
+        poss = np.asarray(positions, np.int32).copy()
+        buds = np.asarray(budgets, np.int32).copy()
+        pf_snap = [(int(slot), list(t), int(sp))
+                   for slot, t, sp, _bt, _temp in pf]
+
+        def run(h: "EchoChunkHandle") -> None:
+            if self._step_delay_s:
+                time.sleep(self._step_delay_s)
+            pf_first = np.full(len(pf_snap), self.spec.eos_id, np.int32)
+            with self._mu:
+                for i, (slot, t, sp) in enumerate(pf_snap):
+                    stream = self._register_prefill(slot, t, sp)
+                    if stream:
+                        pf_first[i] = stream[0]
+            done = np.zeros(self.spec.batch_size, bool)
+            out, tok, pos, done = self._run_chunk_async(
+                toks, poss, done, buds)
+            h._set(out, tok, pos, done, pf_first=pf_first)
+
+        return self._device_submit(run, mixed=True)
 
     def release_slot(self, slot: int) -> None:
         with self._mu:
@@ -250,8 +527,14 @@ class MixedChunkHandle:
 
     def fetch(self) -> tuple:
         """Blocking host transfer: ``(decode tokens (B, K),
-        slice first-tokens (S,))``."""
-        return np.asarray(self.out), np.asarray(self.pf_first)
+        slice first-tokens (S,))`` — ONE batched ``device_get`` for
+        both arrays instead of two serial blocking transfers (each
+        transfer pays the host↔device round-trip on tunneled
+        runtimes)."""
+        import jax
+
+        out, pf = jax.device_get((self.out, self.pf_first))
+        return np.asarray(out), np.asarray(pf)
 
 
 class JaxExecutor:
@@ -599,6 +882,17 @@ class JaxExecutor:
         self._hbm_static: Optional[Dict[int, Dict[str, int]]] = None
         self._warm_mu = threading.Lock()
         self._warm_done = 0
+        #: Reusable host staging buffers per (program, geometry): the
+        #: per-dispatch np.zeros churn killer. Decode/mixed tags are
+        #: bounded by the pipeline depth (≤ 4); prefill tags are NOT
+        #: intrinsically bounded (an onboarding storm dispatches one
+        #: bucket per slot per step with no host sync), so every
+        #: prefill dispatch ticks ``_staging_fence`` — which blocks on
+        #: the just-dispatched program every ring-2 same-tag dispatches
+        #: to fence all earlier programs (FIFO device stream) before
+        #: their staging buffers can be rewritten.
+        self._staging = HostStaging(ring=max(8, batch_size + 4))
+        self._staging_fence_counts: Dict[str, int] = {}
 
     def telemetry_info(self) -> Dict:
         """Model identity for the MFU estimator — shared with the
@@ -691,6 +985,22 @@ class JaxExecutor:
         return chips
 
     # -- helpers -------------------------------------------------------------
+
+    def _staging_fence(self, tag: str, out) -> None:
+        """Staging-aliasing fence for the unbounded-dispatch prefill
+        paths: ``device_put`` may zero-copy alias a staging buffer, so
+        a buffer must not be rewritten (ring wrap) while its program is
+        still queued. Blocking on the NEWEST program's output every
+        ring-2 same-tag dispatches guarantees — the device stream is
+        FIFO — that every earlier program consumed its inputs before
+        the ring can reach them again."""
+        cnt = self._staging_fence_counts.get(tag, 0) + 1
+        self._staging_fence_counts[tag] = cnt
+        if cnt % (self._staging._ring - 2) == 0:
+            try:
+                out.block_until_ready()
+            except Exception:  # noqa: BLE001 — a failed program surfaces
+                pass           # at its own fetch, not at the fence
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1036,10 +1346,12 @@ class JaxExecutor:
         donated cache. Returns the sampled-token device array."""
         jnp = self._jnp
         T = self._bucket_for(len(chunk))
-        padded = np.zeros(T, np.int32)
+        padded = self._staging.take(f"prefill{T}.tok", (T,), np.int32)
         padded[: len(chunk)] = chunk
-        positions = np.minimum(start_pos + np.arange(T),
-                               start_pos + len(chunk) - 1)
+        positions = self._staging.take(f"prefill{T}.pos", (T,), np.int32,
+                                       fill=None)
+        np.add(self._staging.arange(T), start_pos, out=positions)
+        np.minimum(positions, start_pos + len(chunk) - 1, out=positions)
         fn = self._aot.get(f"prefill_b{T}", self._prefill_step)
         with annotate(f"prefill_b{T}"):  # named region in xprof traces
             tok, self.cache = fn(
@@ -1050,6 +1362,7 @@ class JaxExecutor:
                 bt,
                 jnp.asarray([temperature], jnp.float32),
                 self._next_key())
+        self._staging_fence(f"prefill{T}", tok)
         return tok
 
     def prefill(self, tokens: List[int], start_pos: int,
@@ -1061,6 +1374,9 @@ class JaxExecutor:
         pos = start_pos
         remaining = list(tokens)
         tok = None
+        # No explicit fence needed: _prefill_chunk's per-tag staging
+        # fence bounds outstanding same-bucket dispatches for EVERY
+        # caller (this loop, prefill_async, the engine's waves).
         while remaining:
             chunk = remaining[: self.prefill_buckets[-1]]
             remaining = remaining[len(chunk):]
@@ -1082,14 +1398,17 @@ class JaxExecutor:
         N = self.prefill_batch
         assert 0 < len(reqs) <= N, len(reqs)
         T = self._bucket_for(max(len(t) for t, _, _, _ in reqs))
-        toks = np.zeros((N, T), np.int32)
-        poss = np.zeros((N, T), np.int32)
-        lens = np.ones(N, np.int32)    # pad rows: 1 trash token → page 0
-        bts = np.zeros((N, self.spec.max_pages_per_seq), np.int32)
-        temps = np.zeros(N, np.float32)
+        st = self._staging
+        toks = st.take(f"pfm{T}.tok", (N, T), np.int32)
+        poss = st.take(f"pfm{T}.pos", (N, T), np.int32)
+        lens = st.take(f"pfm{T}.len", (N,), np.int32, fill=1)
+        bts = st.take(f"pfm{T}.bt", (N, self.spec.max_pages_per_seq),
+                      np.int32)
+        temps = st.take(f"pfm{T}.temp", (N,), np.float32)
         for i, (t, sp, bt, temp) in enumerate(reqs):
             toks[i, :len(t)] = t
-            poss[i] = np.minimum(sp + np.arange(T), sp + len(t) - 1)
+            np.add(st.arange(T), sp, out=poss[i])
+            np.minimum(poss[i], sp + len(t) - 1, out=poss[i])
             lens[i] = len(t)
             bts[i] = bt
             temps[i] = temp
@@ -1099,6 +1418,7 @@ class JaxExecutor:
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.asarray(poss), jnp.asarray(lens), jnp.asarray(bts),
                 jnp.asarray(temps), self._next_key())
+        self._staging_fence(f"pfm{T}", out)
         return [out[i] for i in range(len(reqs))]
 
     def prefill_async(self, tokens: List[int], start_pos: int,
@@ -1198,15 +1518,18 @@ class JaxExecutor:
         jnp = self._jnp
         S, T = self.mixed_prefill_slices, self.mixed_slice_tokens
         assert 0 < len(pf) <= S, len(pf)
-        pf_toks = np.zeros((S, T), np.int32)
-        pf_poss = np.zeros((S, T), np.int32)
-        pf_lens = np.ones(S, np.int32)     # pad rows: 1 trash token → page 0
-        pf_bts = np.zeros((S, self.spec.max_pages_per_seq), np.int32)
-        pf_temps = np.zeros(S, np.float32)
+        st = self._staging
+        pf_toks = st.take("mixed.tok", (S, T), np.int32)
+        pf_poss = st.take("mixed.pos", (S, T), np.int32)
+        pf_lens = st.take("mixed.len", (S,), np.int32, fill=1)
+        pf_bts = st.take("mixed.bt", (S, self.spec.max_pages_per_seq),
+                         np.int32)
+        pf_temps = st.take("mixed.temp", (S,), np.float32)
         for i, (_slot, t, sp, bt, temp) in enumerate(pf):
             assert 0 < len(t) <= T, len(t)
             pf_toks[i, :len(t)] = t
-            pf_poss[i] = np.minimum(sp + np.arange(T), sp + len(t) - 1)
+            np.add(st.arange(T), sp, out=pf_poss[i])
+            np.minimum(pf_poss[i], sp + len(t) - 1, out=pf_poss[i])
             pf_lens[i] = len(t)
             pf_bts[i] = bt
             pf_temps[i] = temp
@@ -1228,15 +1551,17 @@ class JaxExecutor:
 
     def gather_scalars(self, arrs: List) -> np.ndarray:
         """Fetch an admission wave's device scalars with overlapped
-        transfers (async copy per handle, then collect): no per-size
-        program to compile, and the wall cost is ~one round-trip."""
+        transfers (async copy per handle, then ONE batched
+        ``device_get`` across the wave): no per-size program to
+        compile, and the wall cost is ~one round-trip instead of one
+        blocking per-row fetch each."""
         for a in arrs:
             try:
                 a.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 pass
-        return np.array([int(np.asarray(a)) for a in arrs],
-                        dtype=np.int64)
+        vals = self._jax.device_get(list(arrs))
+        return np.array([int(v) for v in vals], dtype=np.int64)
 
     def release_slot(self, slot: int) -> None:
         pass  # no per-slot host state

@@ -314,6 +314,17 @@ class QueueMetrics:
             "Token readback per chunk: device→host transfer of the "
             "sampled token matrix (ms)", ["engine"],
             buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.step_overlapped_ms = Histogram(
+            f"{ns}_step_overlapped_ms",
+            "Part of a chunk's device span that overlapped other "
+            "in-flight work (async pipeline) — attributed explicitly "
+            "so step_device_ms stays truthful (ms)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.pipeline_overlap_ratio = Gauge(
+            f"{ns}_pipeline_overlap_ratio",
+            "Fraction of in-flight device-span time hidden by the "
+            "async decode pipeline (0 = fully serial)", ["engine"],
+            registry=registry)
         self.decode_tokens_per_s = Gauge(
             f"{ns}_decode_tokens_per_s",
             "Decode tokens/s over the telemetry trailing window",

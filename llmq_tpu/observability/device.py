@@ -155,6 +155,14 @@ class DeviceTelemetry:
         self._dispatch = _StepStat()
         self._device = _StepStat()
         self._readback = _StepStat()
+        self._overlapped = _StepStat()
+        #: High-water mark (perf_counter) of device time already
+        #: attributed to some chunk — the serial-attribution state that
+        #: keeps ``step_device_ms`` truthful under the async pipeline:
+        #: a chunk's device span is only credited where it extends past
+        #: what earlier chunks were already charged for; the rest is
+        #: ``overlapped_ms`` (see ``timed_fetch``).
+        self._accounted_until = 0.0
         self._tokens_total = 0
         self._tok_window: deque = deque()   # (ts, n_tokens)
         # Model identity for the MFU estimator (executor fills these).
@@ -208,18 +216,24 @@ class DeviceTelemetry:
     # -- step decomposition (hot path) ----------------------------------------
 
     def note_step(self, dispatch_s: float, device_s: float,
-                  readback_s: float, tokens: int) -> None:
+                  readback_s: float, tokens: int,
+                  overlapped_s: float = 0.0) -> None:
         """One decode/mixed chunk's timing split. Called once per chunk
         from the engine thread — budgeted at <3 % of the echo step path
-        (guarded in tests)."""
+        (guarded in tests). ``overlapped_s`` is the part of the chunk's
+        device span that overlapped other accounted work (pipelined
+        decode) — kept OUT of ``step_device_ms`` so summed device time
+        never exceeds wall-clock."""
         d_ms = dispatch_s * 1e3
         x_ms = device_s * 1e3
         r_ms = readback_s * 1e3
+        o_ms = overlapped_s * 1e3
         now = time.time()
         with self._mu:
             self._dispatch.add(d_ms)
             self._device.add(x_ms)
             self._readback.add(r_ms)
+            self._overlapped.add(o_ms)
             if tokens > 0:
                 self._tokens_total += tokens
                 self._tok_window.append((now, tokens))
@@ -229,15 +243,30 @@ class DeviceTelemetry:
             while self._tok_window and self._tok_window[0][0] < horizon:
                 self._tok_window.popleft()
         if self.metrics_enabled:
-            self._pending_steps.append((d_ms, x_ms, r_ms))
+            self._pending_steps.append((d_ms, x_ms, r_ms, o_ms))
 
-    def timed_fetch(self, handle):
+    def timed_fetch(self, handle, dispatched_at: Optional[float] = None):
         """Fetch a chunk handle's tokens with the device-execute /
         readback split: ``block_until_ready`` on the output array
         bounds device execution, the ``fetch()`` that follows is the
-        host transfer (``np.asarray`` is the real completion fence on
-        tunneled runtimes, so readback absorbs any under-wait).
-        Returns ``(result, device_s, readback_s)``."""
+        host transfer (``np.asarray``/``device_get`` is the real
+        completion fence on tunneled runtimes, so readback absorbs any
+        under-wait). Returns ``(result, device_s, readback_s,
+        overlapped_s)``.
+
+        Overlap attribution (ISSUE 10): the serial measurement model —
+        "the wait IS the device time" — double-counts once chunks
+        overlap: with two chunks in flight, chunk N+1's wait would
+        include (or hide) time already attributed to chunk N. With
+        ``dispatched_at`` (perf_counter at dispatch), the chunk's
+        device span is ``[dispatched_at, ready]``; only the part past
+        the high-water mark of already-attributed time is NOVEL and
+        charged to ``device_s`` (further capped by the measured wait,
+        so post-ready idle between fetches is never billed as device
+        time); the remainder of the span is returned as
+        ``overlapped_s`` — the wall-clock the pipeline actually hid.
+        Without ``dispatched_at`` the accounting degenerates to the old
+        serial split exactly (device_s = wait, overlapped_s = 0)."""
         t0 = time.perf_counter()
         out = getattr(handle, "out", None)
         if out is not None:
@@ -250,7 +279,17 @@ class DeviceTelemetry:
         t1 = time.perf_counter()
         res = handle.fetch()
         t2 = time.perf_counter()
-        return res, t1 - t0, t2 - t1
+        wait_s = t1 - t0
+        span_start = dispatched_at if dispatched_at else t0
+        with self._mu:
+            acc = self._accounted_until
+            span = max(0.0, t1 - span_start)
+            novel = max(0.0, t1 - max(span_start, acc))
+            device_s = min(novel, wait_s)
+            overlapped_s = max(0.0, span - device_s)
+            if t1 > acc:
+                self._accounted_until = t1
+        return res, device_s, t2 - t1, overlapped_s
 
     # -- decode rate / MFU ----------------------------------------------------
 
@@ -272,6 +311,22 @@ class DeviceTelemetry:
     def mfu(self) -> float:
         return decode_mfu(self.tokens_per_s(), self.n_params,
                           self.device_kind, self.quant)
+
+    def _overlap_ratio_locked(self) -> float:
+        """Single implementation of overlapped/(overlapped+device) —
+        the /metrics gauge and the stats snapshot must never drift
+        apart. Caller holds ``self._mu``."""
+        o = self._overlapped.total_ms
+        d = self._device.total_ms
+        return o / (o + d) if (o + d) > 0 else 0.0
+
+    def overlap_ratio(self) -> float:
+        """Fraction of total in-flight device-span time that overlapped
+        other accounted work — 0 on a fully serial engine, ~0.5 with a
+        saturated depth-2 pipeline. The ``pipeline_overlap_ratio``
+        gauge and the bench's ``point["pipeline"]`` read this."""
+        with self._mu:
+            return self._overlap_ratio_locked()
 
     # -- compile / warmup -----------------------------------------------------
 
@@ -329,16 +384,20 @@ class DeviceTelemetry:
         if hists is None:
             hists = (m.step_dispatch_ms.labels(self.name),
                      m.step_device_ms.labels(self.name),
-                     m.step_readback_ms.labels(self.name))
+                     m.step_readback_ms.labels(self.name),
+                     m.step_overlapped_ms.labels(self.name))
             self._step_hists = hists
         while True:
             try:
-                d_ms, x_ms, r_ms = self._pending_steps.popleft()
+                d_ms, x_ms, r_ms, o_ms = self._pending_steps.popleft()
             except IndexError:
                 break
             hists[0].observe(d_ms)
             hists[1].observe(x_ms)
             hists[2].observe(r_ms)
+            hists[3].observe(o_ms)
+        m.pipeline_overlap_ratio.labels(self.name).set(
+            self.overlap_ratio())
         rate = self.tokens_per_s()
         m.decode_tokens_per_s.labels(self.name).set(rate)
         m.mfu_pct.labels(self.name).set(
@@ -384,7 +443,10 @@ class DeviceTelemetry:
                     "dispatch_ms": self._dispatch.to_dict(),
                     "device_ms": self._device.to_dict(),
                     "readback_ms": self._readback.to_dict(),
+                    "overlapped_ms": self._overlapped.to_dict(),
                 },
+                "pipeline_overlap_ratio": round(
+                    self._overlap_ratio_locked(), 4),
                 "tokens_total": self._tokens_total,
                 "decode_tokens_per_s": round(rate, 1),
                 "mfu_pct": round(

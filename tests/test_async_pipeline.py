@@ -1,0 +1,550 @@
+"""Async host↔device decode pipeline (docs/performance.md "Async
+pipeline"): double-buffered chunk dispatch, batched readback on the
+fetch thread, and off-path completions must be TOKEN-FOR-TOKEN
+equivalent to the synchronous path — across plain decode waves, mixed
+prefill+decode batching, prefix-cache continuation turns, preemption,
+cancellation mid-flight and crash recovery with chunks in flight.
+``executor.async_pipeline.enabled: false`` is a hard off-switch pinned
+byte-identical to the pre-pipeline scheduling, and the overlap
+decomposition (``step_overlapped_ms`` / ``pipeline_overlap_ratio``)
+must prove the pipeline actually hides wall-clock without inflating
+``step_device_ms``."""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from llmq_tpu import chaos
+from llmq_tpu.chaos import InvariantChecker
+from llmq_tpu.core.config import (AsyncPipelineConfig, ChaosConfig,
+                                  MixedBatchConfig, PrefixCacheConfig,
+                                  SupervisorConfig)
+from llmq_tpu.core.types import Priority
+from llmq_tpu.engine.engine import GenRequest, InferenceEngine
+from llmq_tpu.engine.executor import (EchoExecutor, HostStaging,
+                                      JaxExecutor)
+from llmq_tpu.engine.supervisor import EngineSupervisor
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.llama import get_config, init_params
+
+
+def pipe_cfg(enabled=True, depth=2, workers=1):
+    return AsyncPipelineConfig(enabled=enabled, depth=depth,
+                               completion_workers=workers)
+
+
+def mixed_cfg(budget=16, slices=2):
+    return MixedBatchConfig(enabled=True, prefill_token_budget=budget,
+                            max_slices=slices)
+
+
+def make_echo_engine(pipe=None, mixed=None, slots=4, chunk=4,
+                     delay=0.0, metrics=False, name="pipetest", **kw):
+    """Echo engine; the executor's futures API is exposed exactly when
+    the pipeline config is enabled — the builder's wiring."""
+    tok = ByteTokenizer()
+    on = pipe is not None and pipe.enabled
+    ex = EchoExecutor(batch_size=slots, page_size=8, num_pages=256,
+                      max_pages_per_seq=16, eos_id=tok.eos_id,
+                      chunk_size=chunk, mixed_prefill_slices=2,
+                      mixed_slice_tokens=8, async_chunks=on,
+                      step_delay_s=delay)
+    eng = InferenceEngine(ex, tok, enable_metrics=metrics, name=name,
+                          max_decode_steps=64, mixed_batch=mixed,
+                          async_pipeline=pipe, **kw)
+    return eng, ex
+
+
+WAVE = [
+    ("hello world this is a long prompt " * 3, Priority.NORMAL),
+    ("short", Priority.REALTIME),
+    ("medium sized prompt here", Priority.LOW),
+    ("another quite long prompt for slicing " * 2, Priority.HIGH),
+    ("fifth request", Priority.NORMAL),
+    ("sixth one goes last", Priority.LOW),
+]
+
+
+def drive_wave(eng, wave=WAVE, conv=None, steps_between=2, max_new=40):
+    handles = []
+    for i, (prompt, prio) in enumerate(wave):
+        handles.append(eng.submit(GenRequest(
+            id=f"r{i}", prompt=prompt, priority=prio,
+            conversation_id=(conv[i] if conv else ""),
+            max_new_tokens=max_new)))
+        for _ in range(steps_between):
+            eng.step()
+    eng.run_until_idle()
+    return handles
+
+
+class TestEchoEquivalence:
+    def test_decode_wave_equivalence(self):
+        def run(pipe):
+            eng, _ = make_echo_engine(pipe)
+            handles = drive_wave(eng)
+            stats = eng.get_stats()
+            eng.stop()
+            return [h.result.tokens for h in handles], stats
+
+        on, s_on = run(pipe_cfg())
+        off, s_off = run(None)
+        assert on == off
+        # The pipeline actually ran 2-deep, and the off path never
+        # tracked pipeline state.
+        assert s_on["pipeline"]["depth_hist"].get("2", 0) > 0
+        assert "pipeline" not in s_off
+
+    def test_mixed_batch_equivalence(self):
+        def run(pipe):
+            eng, _ = make_echo_engine(pipe, mixed=mixed_cfg())
+            handles = drive_wave(eng)
+            stats = eng.get_stats()
+            eng.stop()
+            return [h.result.tokens for h in handles], stats
+
+        on, s_on = run(pipe_cfg())
+        off, _ = run(None)
+        assert on == off
+        assert s_on["mixed_batch"]["steps"] > 0   # fused path really ran
+
+    def test_conversation_continuation_equivalence(self):
+        """Turn-N continuation prefill over pinned conversation KV and
+        the radix tree rides the pipelined path identically."""
+        def run(pipe):
+            eng, _ = make_echo_engine(
+                pipe, mixed=mixed_cfg(),
+                prefix_cache=PrefixCacheConfig(enabled=True))
+            out = []
+            for turn in range(3):
+                handles = drive_wave(
+                    eng,
+                    wave=[(f"turn {turn} says something longish "
+                           f"{'x' * (10 * turn)}", Priority.NORMAL)] * 3,
+                    conv=[f"c{i}" for i in range(3)],
+                    max_new=24)
+                out.append([h.result.tokens for h in handles])
+            eng.stop()
+            return out
+
+        assert run(pipe_cfg()) == run(None)
+
+    def test_depth3_equivalence_and_bound(self):
+        def run(pipe):
+            eng, _ = make_echo_engine(pipe, delay=0.0005)
+            handles = drive_wave(eng)
+            stats = eng.get_stats()
+            eng.stop()
+            return [h.result.tokens for h in handles], stats
+
+        d3, s3 = run(pipe_cfg(depth=3))
+        off, _ = run(None)
+        assert d3 == off
+        hist = s3["pipeline"]["depth_hist"]
+        assert hist.get("3", 0) > 0          # reached 3 in flight
+        assert all(int(k) <= 3 for k in hist)  # never past the bound
+
+    def test_depth1_reconciles_every_chunk(self):
+        """depth=1 disables speculation entirely — every chunk is
+        reconciled before the next dispatch, streams unchanged."""
+        eng, _ = make_echo_engine(pipe_cfg(depth=1))
+        handles = drive_wave(eng)
+        stats = eng.get_stats()
+        eng.stop()
+        ctl, _ = make_echo_engine(None)
+        ctl_handles = drive_wave(ctl)
+        assert ([h.result.tokens for h in handles]
+                == [h.result.tokens for h in ctl_handles])
+        assert list(stats["pipeline"]["depth_hist"]) == ["1"]
+
+    def test_off_switch_byte_identical(self):
+        """enabled=false restores the pre-pipeline engine exactly: the
+        executor's futures API is hidden, no completion threads spawn,
+        step/scheduling counters and streams match an engine built
+        without the subsystem."""
+        def run(pipe):
+            eng, ex = make_echo_engine(pipe)
+            handles = drive_wave(eng)
+            out = ([h.result.tokens for h in handles], eng.steps,
+                   eng.get_stats().get("pipeline"))
+            comp = eng._completion
+            eng.stop()
+            return out, ex, comp
+
+        off, ex_off, comp_off = run(pipe_cfg(enabled=False))
+        ctl, ex_ctl, comp_ctl = run(None)
+        assert off == ctl
+        assert off[2] is None                   # no pipeline stats block
+        assert ex_off.decode_chunk_start is None
+        assert ex_off.mixed_chunk_start is None
+        assert comp_off is None and comp_ctl is None
+
+
+class TestCompletionExecutor:
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_stream_order_and_done_after_tokens(self, workers):
+        """Per-request token order is the committed order, the handle
+        completes only after every token callback ran, and callbacks
+        run on completion threads — never the dispatching one."""
+        eng, _ = make_echo_engine(pipe_cfg(workers=workers))
+        streams = {}
+        threads = set()
+        done_after = {}
+
+        def cb(rid):
+            def on_token(t):
+                threads.add(threading.current_thread().name)
+                streams.setdefault(rid, []).append(t)
+            return on_token
+
+        handles = []
+        for i, (prompt, prio) in enumerate(WAVE):
+            h = eng.submit(GenRequest(id=f"s{i}", prompt=prompt,
+                                      priority=prio, max_new_tokens=24),
+                           on_token=cb(f"s{i}"))
+            handles.append((f"s{i}", h))
+            eng.step()
+            eng.step()
+        eng.run_until_idle()
+        for rid, h in handles:
+            assert h.wait(5.0)
+            done_after[rid] = streams.get(rid, [])
+            assert h.result.tokens == done_after[rid]
+        assert threads
+        assert all(t.startswith("completion-") for t in threads), threads
+        eng.stop()
+
+    def test_inline_callbacks_with_pipeline_off(self):
+        """Off switch: callbacks stay on the stepping thread (the
+        pre-pipeline behavior) and no completion pool exists."""
+        eng, _ = make_echo_engine(None)
+        seen = []
+        h = eng.submit(GenRequest(id="x", prompt="inline tokens",
+                                  max_new_tokens=8),
+                       on_token=lambda t: seen.append(
+                           threading.current_thread().name))
+        eng.run_until_idle()
+        assert h.result is not None
+        assert seen and all(n == threading.current_thread().name
+                            for n in seen)
+        assert eng._completion is None
+
+
+class TestCancellationPreemption:
+    def test_cancel_with_chunk_in_flight(self):
+        """A cancel landing while chunks are dispatched is acted on at
+        the fresh-dispatch path only: the stale futures' tokens are
+        dropped with the row, no slot or page leaks."""
+        eng, _ = make_echo_engine(pipe_cfg(), delay=0.001)
+        doomed = eng.submit(GenRequest(id="doomed",
+                                       prompt="cancel me mid flight " * 4,
+                                       max_new_tokens=48))
+        keep = eng.submit(GenRequest(id="keep", prompt="steady " * 6,
+                                     max_new_tokens=32))
+        for _ in range(30):
+            eng.step()
+            if eng._chunk_inflight is not None:
+                break
+        assert eng._chunk_inflight is not None
+        doomed.cancel()
+        eng.run_until_idle()
+        assert doomed.result.finish_reason == "cancelled"
+        assert keep.result.finish_reason in ("eos", "length")
+        assert eng.allocator.used() == eng.allocator.pinned_pages()
+        assert all(s is None for s in eng._slots)
+        eng.stop()
+
+    def test_preemption_equivalence_single_slot(self):
+        """Slot preemption with the pipeline in flight is deferred to
+        the reconcile (rows on device are untouchable), then runs —
+        streams identical to the synchronous path."""
+        def run(pipe):
+            eng, _ = make_echo_engine(pipe, slots=1)
+            low = eng.submit(GenRequest(
+                id="low", prompt="background work " * 4,
+                priority=Priority.LOW, max_new_tokens=48))
+            for _ in range(6):
+                eng.step()
+            rt = eng.submit(GenRequest(
+                id="rt", prompt="urgent realtime request",
+                priority=Priority.REALTIME, max_new_tokens=8))
+            eng.run_until_idle()
+            eng.stop()
+            return low.result.tokens, rt.result.tokens
+
+        assert run(pipe_cfg()) == run(None)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+class TestCrashRecovery:
+    @pytest.fixture(autouse=True)
+    def _chaos_reset(self):
+        yield
+        chaos.configure(None)
+
+    def test_crash_with_two_chunks_in_flight_zero_loss_zero_dup(self):
+        """Chaos ``engine.step`` crash while TWO chunks are dispatched
+        (depth-3 steady state): the supervisor recovers every snapshot,
+        the queued completions drain before handles are re-failed
+        (zero duplicate), the stream stays a monotone prefix, and a
+        retry completes cleanly (zero loss)."""
+        inj = chaos.configure(ChaosConfig(enabled=True, seed=21))
+        checker = InvariantChecker()
+        eng, _ = make_echo_engine(pipe_cfg(depth=3), delay=0.001)
+        sup = EngineSupervisor(eng, config=SupervisorConfig(),
+                               enable_metrics=False)
+        h = eng.submit(GenRequest(id="s0",
+                                  prompt="stream me through a crash " * 3,
+                                  max_new_tokens=48),
+                       on_token=checker.on_token("s0"))
+        checker.submitted("s0")
+        # Drive synchronously until the pipeline is 3-deep-capable and
+        # holds TWO dispatched chunks between steps (depth-3 steady
+        # state), with tokens already streamed.
+        for _ in range(200):
+            eng.step()
+            if (len(eng._inflight) >= 2
+                    and len(checker._streams.get("s0", [])) >= 3):
+                break
+        assert len(eng._inflight) >= 2
+        eng._drain_completions()
+        assert len(checker._streams.get("s0", [])) >= 3
+        # Arm the crash and hand the engine to its loop thread: the
+        # FIRST threaded step dies with both chunks in flight.
+        inj.add_rule("engine.step", kind="crash", times=1)
+        eng.start()
+        import time as _t
+        deadline = _t.time() + 5.0
+        while eng.running and _t.time() < deadline:
+            _t.sleep(0.01)
+        assert not eng.running
+        assert sup.check_once()            # detect + recover + restart
+        assert not eng._inflight           # every snapshot dropped
+        assert h.wait(2.0)
+        assert h.result.finish_reason == "error"
+        checker.failed("s0")
+        checker.completed("s0", tokens=h.result.tokens)
+        checker._terminal["s0"].remove("completed")  # monotone check only
+        # Retry (new id) completes on the restarted, still-pipelined
+        # engine.
+        h2 = eng.submit(GenRequest(id="s1",
+                                   prompt="stream me through a crash " * 3,
+                                   max_new_tokens=24),
+                        on_token=checker.on_token("s1"))
+        checker.submitted("s1")
+        assert h2.wait(10.0)
+        assert h2.result.finish_reason in ("eos", "length")
+        eng._drain_completions()
+        checker.completed("s1", tokens=h2.result.tokens)
+        eng.stop()
+        sup.stop()
+        checker.check()
+
+
+class TestOverlapTelemetry:
+    def test_overlap_measured_and_device_not_inflated(self):
+        """With a simulated device delay, the pipeline's hidden
+        wall-clock lands in overlapped_ms (ratio > 0) while summed
+        step_device_ms stays ≤ the phase's wall-clock (no
+        double-counting)."""
+        import time as _t
+
+        eng, _ = make_echo_engine(pipe_cfg(), delay=0.002,
+                                  name="overlap-echo")
+        t0 = _t.perf_counter()
+        drive_wave(eng, max_new=32)
+        wall_ms = (_t.perf_counter() - t0) * 1e3
+        snap = eng._telemetry.snapshot()
+        steps = snap["steps"]
+        assert snap["pipeline_overlap_ratio"] > 0
+        assert steps["overlapped_ms"]["total_ms"] > 0
+        assert steps["device_ms"]["total_ms"] <= wall_ms
+        assert eng.get_stats()["pipeline"]["overlap_ratio"] > 0
+        eng.stop()
+
+    def test_serial_path_reports_zero_overlap(self):
+        eng, _ = make_echo_engine(None, name="serial-echo")
+        drive_wave(eng)
+        snap = eng._telemetry.snapshot()
+        assert snap["pipeline_overlap_ratio"] == 0.0
+        assert snap["steps"]["overlapped_ms"]["total_ms"] == 0.0
+        eng.stop()
+
+    def test_metric_families_exposed(self):
+        from llmq_tpu.metrics.registry import exposition, get_metrics
+
+        get_metrics()
+        eng, _ = make_echo_engine(pipe_cfg(), delay=0.001, metrics=True,
+                                  name="pipemetrics")
+        drive_wave(eng, max_new=16)
+        exp = exposition().decode()
+        assert "llm_queue_step_overlapped_ms" in exp
+        assert ('llm_queue_pipeline_overlap_ratio{engine="pipemetrics"}'
+                in exp)
+        eng.stop()
+
+    def test_timed_fetch_overlap_attribution(self):
+        """Unit pin for the serial-attribution math: two chunks whose
+        spans overlap split into novel device time + overlapped time;
+        without dispatched_at the old serial split is exact."""
+        import time as _t
+
+        from llmq_tpu.observability.device import DeviceTelemetry
+
+        tel = DeviceTelemetry("tf-unit", metrics=False)
+
+        class H:
+            def __init__(self, delay):
+                self.delay = delay
+
+            def fetch(self):
+                return np.zeros(1)
+
+        class Out:
+            def __init__(self, delay):
+                self.delay = delay
+
+            def block_until_ready(self):
+                _t.sleep(self.delay)
+
+        # Chunk A: dispatched now, 20ms compute.
+        h = H(0.0)
+        h.out = Out(0.02)
+        t_dispatch = _t.perf_counter()
+        _, dev_a, _, ov_a = tel.timed_fetch(h, dispatched_at=t_dispatch)
+        assert dev_a == pytest.approx(0.02, abs=0.01)
+        assert ov_a < 0.005
+        # Chunk B: dispatched BEFORE chunk A finished (span overlaps
+        # the attributed window) — the overlap is attributed, not
+        # double-counted as device time.
+        h2 = H(0.0)
+        h2.out = Out(0.001)
+        _, dev_b, _, ov_b = tel.timed_fetch(
+            h2, dispatched_at=t_dispatch + 0.005)
+        assert ov_b > 0.005            # hidden behind chunk A's window
+        assert dev_b <= 0.01
+        # No dispatched_at → exact old behavior: wait is device time.
+        h3 = H(0.0)
+        h3.out = Out(0.003)
+        _, dev_c, _, ov_c = tel.timed_fetch(h3)
+        assert dev_c == pytest.approx(0.003, abs=0.003)
+        assert ov_c == 0.0
+
+
+class TestHostStaging:
+    def test_ring_rotation_and_fill(self):
+        st = HostStaging(ring=3)
+        bufs = [st.take("t", (4,), np.int32) for _ in range(3)]
+        assert len({id(b) for b in bufs}) == 3     # distinct slots
+        bufs[0][:] = 7
+        again = st.take("t", (4,), np.int32)       # wraps to slot 0
+        assert again is bufs[0]
+        assert (again == 0).all()                  # re-zeroed
+        ones = st.take("t2", (2,), np.int32, fill=1)
+        assert (ones == 1).all()
+        raw = st.take("t3", (2,), np.int32, fill=None)
+        assert raw.shape == (2,)
+
+    def test_arange_cached_readonly(self):
+        st = HostStaging()
+        a = st.arange(8)
+        assert a is st.arange(8)
+        assert not a.flags.writeable
+        assert (a == np.arange(8)).all()
+
+    def test_geometries_do_not_collide(self):
+        st = HostStaging(ring=2)
+        a = st.take("x", (4,), np.int32)
+        b = st.take("x", (8,), np.int32)
+        c = st.take("x", (4,), np.float32)
+        assert a.shape == (4,) and b.shape == (8,)
+        assert c.dtype == np.float32
+
+
+# -- CPU-mode JAX equivalence --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("llama3-tiny", max_seq_len=256, vocab_size=512)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_jax_engine(tiny_model, pipe, *, slots=2, mixed=None,
+                    prefix_cache=None, max_decode_steps=16):
+    cfg, params = tiny_model
+    tok = ByteTokenizer()
+    ex = JaxExecutor(cfg, params, batch_size=slots, page_size=8,
+                     num_pages=96, prefill_buckets=[16, 64],
+                     eos_id=tok.eos_id, chunk_size=4,
+                     mixed_prefill_slices=2, mixed_slice_tokens=8)
+    return InferenceEngine(ex, tok, enable_metrics=False,
+                           max_decode_steps=max_decode_steps,
+                           prefix_cache=prefix_cache, mixed_batch=mixed,
+                           async_pipeline=pipe)
+
+
+class TestJaxEquivalence:
+    def test_wave_with_preemption_streams_identical(self, tiny_model):
+        """Greedy CPU-mode JAX: admission waves + a realtime arrival
+        that preempts — identical per-request streams with the
+        pipeline at depth 2 and 3 vs off."""
+        def run(pipe):
+            eng = make_jax_engine(tiny_model, pipe)
+            handles = []
+            wave = [("a long prompt that needs slicing into chunks",
+                     Priority.LOW),
+                    ("second prompt arrives", Priority.NORMAL),
+                    ("urgent!", Priority.REALTIME),
+                    ("fourth one trails behind the others",
+                     Priority.HIGH)]
+            for i, (p, prio) in enumerate(wave):
+                handles.append(eng.submit(GenRequest(
+                    id=f"j{i}", prompt=p, priority=prio,
+                    max_new_tokens=10)))
+                eng.step()
+                eng.step()
+            eng.run_until_idle()
+            out = [h.result.tokens for h in handles]
+            stats = eng.get_stats()
+            eng.stop()
+            return out, stats
+
+        off, _ = run(None)
+        d2, s2 = run(pipe_cfg(depth=2))
+        d3, _ = run(pipe_cfg(depth=3))
+        assert d2 == off
+        assert d3 == off
+        assert s2["pipeline"]["overlap_ratio"] >= 0.0
+
+    def test_mixed_prefix_continuation_equivalence(self, tiny_model):
+        """Multi-turn conversations over the radix prefix cache with
+        mixed batching — the pipelined engine decodes identically."""
+        def run(pipe):
+            eng = make_jax_engine(
+                tiny_model, pipe, slots=3, mixed=mixed_cfg(),
+                prefix_cache=PrefixCacheConfig(enabled=True))
+            out = []
+            for turn in range(2):
+                handles = []
+                for c in range(3):
+                    handles.append(eng.submit(GenRequest(
+                        id=f"t{turn}c{c}",
+                        prompt=f" turn {turn} for conversation {c}",
+                        conversation_id=f"conv{c}",
+                        max_new_tokens=8)))
+                    eng.step()
+                eng.run_until_idle()
+                out.append([h.result.tokens for h in handles])
+            assert eng.prefix_hits > 0 or any(
+                h.result.cached_tokens > 0 for h in handles)
+            eng.stop()
+            return out
+
+        assert run(pipe_cfg()) == run(None)
