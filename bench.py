@@ -13,6 +13,9 @@ Extra fields:
 - ``tenancy``: two-tenant 4:1-weight isolation against the echo engine
   (docs/tenancy.md) — achieved token share under saturation and the
   victim tenant's realtime p99 with and without an aggressor burst.
+- ``controlplane``: 4× traffic ramp A/B (docs/controlplane.md) —
+  static 4-replica profile vs controller-managed, reporting realtime
+  p99, replica-seconds consumed and the waste decomposition for both.
 - ``tpu``: single-chip decode tokens/s, per-step ms, prefill tokens/s
   (serialized + pipelined) and MFU with a real paged-KV Llama model
   (BASELINE config #2) when an accelerator is present.
@@ -40,7 +43,9 @@ SLA sweeps for A/B comparison), LLMQ_BENCH_MIXED_BATCH (=0 disables
 token-budget mixed prefill+decode batching for A/B) /
 LLMQ_BENCH_MIXED_BUDGET / LLMQ_BENCH_MIXED_SLICES,
 LLMQ_BENCH_TENANCY_RATE / LLMQ_BENCH_TENANCY_SECS (victim offered rate
-and per-phase duration for the tenancy isolation section).
+and per-phase duration for the tenancy isolation section),
+LLMQ_BENCH_CONTROLPLANE_RATE / LLMQ_BENCH_CONTROLPLANE_SECS (base
+offered rate and per-phase duration for the control-plane ramp A/B).
 """
 
 from __future__ import annotations
@@ -491,6 +496,220 @@ def bench_tenancy_isolation(rate_per_s: float = 300.0,
         f"{p99_solo_ms:.1f}ms solo → {p99_burst_ms:.1f}ms under burst "
         f"({delta_pct:+.1f}%) vs {p99_fifo_ms:.1f}ms FIFO control "
         f"({isolation_x:.0f}x isolation)")
+    return out
+
+
+# -- 2c. control plane: 4x ramp A/B (docs/controlplane.md) --------------------
+
+def bench_controlplane_ramp(base_rate: float = 20.0,
+                            phase_s: float = 2.0) -> Dict:
+    """4× traffic ramp served twice by the SAME replica recipe
+    (echo engines with a simulated 10 ms device chunk, so capacity is
+    finite and scaling matters):
+
+    A. **static** — 4 replicas provisioned up front, controller off;
+    B. **controller** — min 1 / max 4, the reconcile loop scales on
+       backlog and drains back down when the ramp ends.
+
+    The ramp is 4 open-loop Poisson phases at 1×/2×/3×/4× the base
+    rate (realtime tier, 16-token completions). Reports, for both
+    profiles: realtime p99, replica-seconds consumed (integral of
+    healthy replicas over the serving window — the cost axis), and
+    the usage ledger's waste-decomposition delta."""
+    from llmq_tpu.cluster.router import ClusterRouter
+    from llmq_tpu.controlplane import LocalEnginePool, ReplicaController
+    from llmq_tpu.core.config import (ClusterConfig, ControlPlaneConfig,
+                                      LoadBalancerConfig)
+    from llmq_tpu.engine import (ByteTokenizer, EchoExecutor,
+                                 InferenceEngine)
+    from llmq_tpu.loadbalancer.load_balancer import (EndpointStatus,
+                                                     LoadBalancer)
+    from llmq_tpu.observability.usage import get_usage_ledger
+    from llmq_tpu.queueing.factory import QueueFactory, QueueType
+
+    def mk_pool(prefix: str) -> LocalEnginePool:
+        def factory(seq: int) -> InferenceEngine:
+            tok = ByteTokenizer()
+            ex = EchoExecutor(batch_size=2, page_size=16, num_pages=512,
+                              max_pages_per_seq=8, eos_id=tok.eos_id,
+                              chunk_size=4, step_delay_s=0.02)
+            return InferenceEngine(ex, tok, name=f"{prefix}-{seq}",
+                                   enable_metrics=False,
+                                   max_decode_steps=16)
+
+        return LocalEnginePool(factory, supervise=False)
+
+    def run_profile(name: str, managed: bool) -> Dict:
+        cfg = default_config()
+        cfg.queue.worker.max_batch_size = 4
+        cfg.queue.worker.process_interval = 0.001
+        # Bounded in-flight dispatch: overload must back up IN THE
+        # QUEUE (where the controller's backlog signal reads it), not
+        # in an unbounded worker thread pool parked at engine
+        # admission.
+        cfg.queue.worker.max_concurrent = 4
+        cfg.queue.enable_metrics = False
+        lb = LoadBalancer(LoadBalancerConfig(
+            strategy="least_connections", health_check_interval=0.0))
+        router = ClusterRouter(
+            lb, config=ClusterConfig(failover_retries=2),
+            enable_metrics=False)
+        pool = mk_pool(name)
+        factory = QueueFactory(cfg)
+        manager = factory.create_queue_manager(f"cp-{name}",
+                                               QueueType.STANDARD)
+        ctl = None
+        if managed:
+            ctl = ReplicaController(
+                config=ControlPlaneConfig(
+                    enabled=True, interval=0.05, min_replicas=1,
+                    max_replicas=4, backlog_per_replica=4,
+                    cooldown=0.25, max_actions_per_minute=30,
+                    rungs=[]),
+                router=router, pool=pool, queue_manager=manager,
+                enable_metrics=False)
+            ctl.run_once()                  # bootstrap min_replicas
+            ctl.start()
+        else:
+            for seq in range(1, 5):
+                ep = pool.provision(seq)
+                if ep is not None:
+                    lb.add_endpoint(ep)
+        lat: List[float] = []
+        lock = threading.Lock()
+        submit_t: Dict[str, float] = {}
+
+        def process(ctx, msg: Message) -> None:
+            router.process_fn(ctx, msg)
+            now = time.perf_counter()
+            with lock:
+                t0 = submit_t.pop(msg.id, None)
+                if t0 is not None:
+                    lat.append(now - t0)
+
+        workers = factory.create_workers(f"cp-{name}", 2, process)
+        for w in workers:
+            w.start()
+        snap0 = get_usage_ledger().snapshot(top_conversations=0)
+        waste0 = ((snap0.get("totals") or {})
+                  .get("waste_device_seconds") or 0.0)
+        by_reason0 = dict(snap0.get("waste_by_reason") or {})
+        rng = random.Random(17)
+        n_sent = 0
+        replica_seconds = 0.0
+        peak_live = 0
+        t_start = time.perf_counter()
+        nxt = t_start
+        last_sample = t_start
+        phase_rates = [base_rate * m for m in (1, 2, 3, 4)]
+        log(f"[controlplane] {name}: ramp "
+            f"{'/'.join(f'{r:.0f}' for r in phase_rates)} req/s × "
+            f"{phase_s:.0f}s each ...")
+        total_s = phase_s * len(phase_rates)
+        while True:
+            now = time.perf_counter()
+            elapsed = now - t_start
+            if elapsed >= total_s:
+                break
+            live = sum(1 for e in lb.endpoints()
+                       if e.status in (EndpointStatus.HEALTHY,
+                                       EndpointStatus.DEGRADED))
+            peak_live = max(peak_live, live)
+            replica_seconds += live * (now - last_sample)
+            last_sample = now
+            rate = phase_rates[min(len(phase_rates) - 1,
+                                   int(elapsed // phase_s))]
+            if now < nxt:
+                time.sleep(min(0.002, nxt - now))
+                continue
+            nxt += rng.expovariate(rate)
+            mid = f"cp-{name}-{n_sent}"
+            m = Message(id=mid, content="ramp req", user_id="bench",
+                        priority=Priority.REALTIME, timeout=30.0)
+            m.metadata["max_new_tokens"] = 16
+            with lock:
+                submit_t[mid] = time.perf_counter()
+            manager.push_message(m)
+            n_sent += 1
+        # Drain, still integrating replica-seconds (the controller's
+        # scale-down after the ramp is part of the cost story).
+        drain_deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < drain_deadline:
+            now = time.perf_counter()
+            live = sum(1 for e in lb.endpoints()
+                       if e.status in (EndpointStatus.HEALTHY,
+                                       EndpointStatus.DEGRADED))
+            replica_seconds += live * (now - last_sample)
+            last_sample = now
+            with lock:
+                if len(lat) >= n_sent:
+                    break
+            time.sleep(0.02)
+        scaled_down_clean = None
+        if ctl is not None:
+            # Give the controller a moment to drain back toward the
+            # floor, then require the drains completed cleanly.
+            idle_deadline = time.perf_counter() + 8.0
+            while time.perf_counter() < idle_deadline:
+                eps = lb.endpoints()
+                if (len(eps) <= 2 and not ctl._draining):  # noqa: SLF001
+                    break
+                time.sleep(0.05)
+            scaled_down_clean = bool(not ctl._draining)  # noqa: SLF001
+            ctl.stop()
+        factory.stop_all()
+        pool.stop()
+        snap1 = get_usage_ledger().snapshot(top_conversations=0)
+        waste1 = ((snap1.get("totals") or {})
+                  .get("waste_device_seconds") or 0.0)
+        by_reason1 = dict(snap1.get("waste_by_reason") or {})
+        with lock:
+            done = len(lat)
+            p99 = pctl(lat, 0.99)
+            p50 = pctl(lat, 0.5)
+        out = {
+            "sent": n_sent, "completed": done,
+            "realtime_p50_ms": round(p50 * 1e3, 2),
+            "realtime_p99_ms": round(p99 * 1e3, 2),
+            "replica_seconds": round(replica_seconds, 2),
+            "peak_replicas": peak_live,
+            "waste_device_seconds": round(waste1 - waste0, 6),
+            # PR 7 ledger decomposition: which failure/churn modes the
+            # profile's waste came from (retry/failover/preempt/...).
+            "waste_by_reason": {
+                k: round(by_reason1.get(k, 0.0)
+                         - by_reason0.get(k, 0.0), 6)
+                for k in by_reason1
+                if by_reason1.get(k, 0.0) - by_reason0.get(k, 0.0)
+                > 1e-9},
+        }
+        if ctl is not None:
+            out["actions"] = dict(ctl.action_counts)
+            out["scaled_down_clean"] = scaled_down_clean
+        log(f"[controlplane] {name}: p99 "
+            f"{out['realtime_p99_ms']:.1f}ms, "
+            f"{out['replica_seconds']:.1f} replica-s, peak "
+            f"{peak_live} replicas, {done}/{n_sent} done")
+        return out
+
+    static = run_profile("static", managed=False)
+    managed = run_profile("managed", managed=True)
+    saved = 0.0
+    if static["replica_seconds"] > 0:
+        saved = 100.0 * (1.0 - managed["replica_seconds"]
+                         / static["replica_seconds"])
+    out = {
+        "base_rate_per_s": base_rate,
+        "phase_s": phase_s,
+        "static": static,
+        "controller": managed,
+        "replica_seconds_saved_pct": round(saved, 1),
+    }
+    log(f"[controlplane] replica-seconds saved by the controller: "
+        f"{saved:.1f}% (static {static['replica_seconds']:.1f} vs "
+        f"managed {managed['replica_seconds']:.1f}); p99 "
+        f"{static['realtime_p99_ms']:.1f} → "
+        f"{managed['realtime_p99_ms']:.1f} ms")
     return out
 
 
@@ -1360,6 +1579,16 @@ def main() -> None:
                                             "4")))
     except Exception as e:  # noqa: BLE001
         log(f"[tenancy] isolation bench failed: {type(e).__name__}: {e}")
+    controlplane_res = None
+    try:
+        controlplane_res = bench_controlplane_ramp(
+            base_rate=float(os.environ.get(
+                "LLMQ_BENCH_CONTROLPLANE_RATE", "20")),
+            phase_s=float(os.environ.get(
+                "LLMQ_BENCH_CONTROLPLANE_SECS", "2")))
+    except Exception as e:  # noqa: BLE001
+        log(f"[controlplane] ramp bench failed: "
+            f"{type(e).__name__}: {e}")
     tpu = None
     tpu_tiers = None
     tpu_tiers_8b = None
@@ -1394,6 +1623,7 @@ def main() -> None:
         "queue": qres,
         "tiers": tiers,
         "tenancy": tenancy_res,
+        "controlplane": controlplane_res,
         "tpu": tpu,
         "tpu_tiers": tpu_tiers,
         "tpu_tiers_8b": tpu_tiers_8b,
@@ -1406,6 +1636,11 @@ def main() -> None:
                 (tenancy_res or {}).get("achieved_share_a_to_b"),
             "tenant_victim_p99_delta_pct":
                 (tenancy_res or {}).get("victim_p99_delta_pct"),
+            "controller_replica_seconds_saved_pct":
+                (controlplane_res or {}).get("replica_seconds_saved_pct"),
+            "controller_realtime_p99_ms":
+                ((controlplane_res or {}).get("controller") or {})
+                .get("realtime_p99_ms"),
             "decode_tokens_per_s": (tpu or {}).get("decode_tokens_per_s"),
             "max_rate_realtime_p99_ok":
                 (tpu_tiers or {}).get("max_rate_realtime_p99_ok"),

@@ -128,6 +128,25 @@ class App:
                                            and cfg.cluster.include_local)
                      else "")
 
+        # Self-healing control plane (llmq_tpu/controlplane/,
+        # docs/controlplane.md): the controller needs the replica-set
+        # routing seam, so a serve process WITHOUT configured peers
+        # gets a ClusterRouter built over its own engine — provisioned
+        # replicas then actually receive traffic. The controller itself
+        # is wired after the API server below (it applies the ladder at
+        # the server's overload shedder).
+        self.controller = None
+        if (cfg.controlplane.enabled and self.cluster_router is None
+                and self.engine is not None):
+            from llmq_tpu.cluster.router import ClusterRouter
+            self.cluster_router = ClusterRouter(
+                self.load_balancer, config=cfg.cluster,
+                state_manager=self.state_manager,
+                enable_metrics=cfg.queue.enable_metrics)
+            self.cluster_router.register_engine(self.engine)
+            log.info("control plane: cluster router built over the "
+                     "local engine")
+
         # Split-deployment transport (queueing/spool.py): consumer side
         # pulls spooled messages into the local queues and acks results;
         # gateway side relays drained messages out and applies acks.
@@ -179,8 +198,36 @@ class App:
             if spool_dir and not with_workers:
                 self._wire_spool_gateway(spool_dir)
 
+        # Control-plane controller (after the API server: the ladder
+        # actuates through its overload shedder). Hard off-switch:
+        # controlplane.enabled=false builds NOTHING — every path above
+        # ran exactly as before.
+        if cfg.controlplane.enabled and self.cluster_router is not None:
+            from llmq_tpu.controlplane import build_controller
+            self.controller = build_controller(
+                cfg, self.cluster_router,
+                queue_manager=self.factory.get_queue_manager("standard"),
+                shedder=(self.api.shedder if self.api is not None
+                         else None),
+                supervisor=self.supervisor)
+            if self.api is not None:
+                self.api.controller = self.controller
+            if self.controller is not None:
+                log.info("control plane up: %d..%d replicas, %d ladder "
+                         "rung(s), pool=%s",
+                         cfg.controlplane.min_replicas,
+                         cfg.controlplane.max_replicas,
+                         len(cfg.controlplane.rungs),
+                         cfg.controlplane.pool.kind)
+
         self.autoscaler = None
-        if with_scheduler:
+        if with_scheduler and self.controller is None:
+            # The legacy threshold autoscaler and the control plane
+            # must never share a LoadBalancer: both add/remove
+            # endpoints, and the autoscaler (no burn signal, no pool
+            # ownership) would strip endpoints the controller then
+            # re-provisions — two reconcilers fighting. The controller
+            # supersedes it whenever it exists.
             mgr = self.factory.get_queue_manager("standard")
             self.autoscaler = Autoscaler(mgr, self.load_balancer,
                                          cfg.scheduler)
@@ -443,6 +490,8 @@ class App:
             w.start()
         if self.autoscaler is not None:
             self.autoscaler.start()
+        if self.controller is not None:
+            self.controller.start()
         if self.spool_consumer is not None:
             self.spool_consumer.start()
         if self.spool_collector is not None:
@@ -456,6 +505,10 @@ class App:
     def stop(self) -> None:
         """Shutdown cascade mirroring cmd/server/main.go:109-118."""
         log.info("shutting down ...")
+        if self.controller is not None:
+            # FIRST: a live controller would react to the teardown
+            # below (replicas "dying") with replacements.
+            self.controller.stop()
         if self.supervisor is not None:
             # BEFORE the engine stops: a supervisor that outlives the
             # deliberate engine.stop() would "recover" it as a crash.
@@ -515,6 +568,15 @@ class App:
 
 def _load(args) -> Config:
     cfg = load_config(args.config) if args.config else load_config()
+    if args.config:
+        # Children this process spawns (the control plane's subprocess
+        # replica pool) must serve the SAME configuration: export the
+        # resolved path so load_config in the child finds it through
+        # the LLMQ_CONFIG env inheritance — a replica silently falling
+        # back to defaults would join the LB with the wrong
+        # model/limits/tenancy settings.
+        import os
+        os.environ["LLMQ_CONFIG"] = os.path.abspath(args.config)
     if args.host:
         cfg.server.host = args.host
     if args.port is not None:

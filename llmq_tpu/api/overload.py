@@ -59,8 +59,15 @@ class OverloadShedder:
                                       10000))
         self.queue_depth_limit = limit
         self._mu = threading.Lock()
+        #: Degradation-ladder overrides (llmq_tpu/controlplane/ladder.py,
+        #: docs/controlplane.md): None when no rung is active — the
+        #: admit path then reduces to one attribute check, identical to
+        #: pre-controlplane behavior. An active rung tightens the
+        #: backlog/headroom thresholds and may shed whole priority
+        #: tiers or low-weight tenants with an explicit 429.
+        self._degradation: Optional[dict] = None
         self.shed_counts = {"backlog": 0, "sla": 0, "engine_down": 0,
-                            "tenant_quota": 0}
+                            "tenant_quota": 0, "degraded": 0}
         self._metrics = None
         if enable_metrics:
             try:
@@ -83,23 +90,33 @@ class OverloadShedder:
         # charge must see the same figure.
         est_tokens = estimate_tokens(msg)
         self._reject_over_quota(msg, est_tokens, retry_base)
+        deg = self._degradation
+        if deg is not None:
+            self._reject_degraded(msg, deg, retry_base)
         eng = self.engine
         if eng is not None and not getattr(eng, "running", True):
             self._shed("engine_down", 503, retry_base,
                        "engine not running on this host (restarting or "
                        "failed) — retry or use another replica")
-        if manager is not None and self.queue_depth_limit > 0:
+        depth_limit = self.queue_depth_limit
+        if deg is not None and depth_limit > 0:
+            depth_limit = max(1, int(depth_limit
+                                     * float(deg.get("backlog_factor",
+                                                     1.0))))
+        if manager is not None and depth_limit > 0:
             try:
                 depth = manager.total_pending()
             except Exception:  # noqa: BLE001 — advisory check
                 depth = 0
-            if depth >= self.queue_depth_limit:
+            if depth >= depth_limit:
                 self._shed(
                     "backlog", 429,
                     max(retry_base, float(estimated_wait)),
                     f"queue backlog too deep ({depth} pending >= "
-                    f"{self.queue_depth_limit})")
+                    f"{depth_limit})")
         headroom = float(getattr(self.config, "deadline_headroom", 0.0))
+        if deg is not None and headroom > 0:
+            headroom *= float(deg.get("headroom_factor", 1.0))
         if headroom > 0 and msg.timeout and msg.timeout > 0:
             eta = float(estimated_wait) + self._prefill_eta_s(msg)
             if eta > msg.timeout * headroom:
@@ -144,6 +161,45 @@ class OverloadShedder:
                 f"(sustained {reg.spec_for(tenant).token_rate:.0f} "
                 f"tok/s)")
 
+    # -- degradation ladder seam (docs/controlplane.md) ----------------------
+
+    def set_degradation(self, spec: Optional[dict]) -> None:
+        """Apply (or clear, with None) the control plane's active
+        degradation rung. Thread-safe by assignment atomicity: the
+        admit path reads the attribute once per request."""
+        self._degradation = dict(spec) if spec else None
+        if spec:
+            log.warning("degradation rung active: %s",
+                        spec.get("name", "?"))
+        else:
+            log.info("degradation cleared (admission back to normal)")
+
+    def _reject_degraded(self, msg: Message, deg: dict,
+                         retry_base: float) -> None:
+        """Rung-declared outright sheds: whole priority tiers (batch
+        first), then tenants below a fairness-weight bound. Explicit
+        429s with reason "degraded" — clients see backpressure before
+        the SLO burns, not after."""
+        tiers = deg.get("shed_priorities") or ()
+        tier = msg.priority.tier_name
+        if tier in tiers:
+            self._shed(
+                "degraded", 429, retry_base,
+                f"degradation rung {deg.get('name', '?')!r} is "
+                f"shedding the {tier!r} tier — retry later")
+        weight_bound = float(deg.get("shed_tenant_weight_below", 0.0)
+                             or 0.0)
+        reg = self.tenant_registry
+        if (weight_bound > 0 and reg is not None
+                and getattr(reg, "enabled", False)):
+            tenant = sanitize_tenant(getattr(msg, "tenant_id", ""))
+            if reg.spec_for(tenant).weight < weight_bound:
+                self._shed(
+                    "degraded", 429, retry_base,
+                    f"degradation rung {deg.get('name', '?')!r} is "
+                    f"shedding tenants under weight {weight_bound} "
+                    f"(tenant {tenant!r})")
+
     def _charge_tenant(self, msg: Message, est_tokens: int) -> None:
         """The request passed every gate: NOW consume its tokens from
         the tenant's bucket (unconditionally — a concurrent admit may
@@ -185,8 +241,11 @@ class OverloadShedder:
                        retry_after=retry_after)
 
     def get_stats(self) -> dict:
+        deg = self._degradation
         with self._mu:
             return {"queue_depth_limit": self.queue_depth_limit,
+                    "degradation": (deg.get("name", "?") if deg
+                                    else None),
                     "shed": dict(self.shed_counts)}
 
 

@@ -143,6 +143,7 @@ class ApiServer:
         resource_scheduler=None,
         engine=None,
         cluster_router=None,
+        controller=None,
         drain_hook: Optional[Callable[[], None]] = None,
         message_store: Optional[MessageStore] = None,
         allowed_origins: Optional[List[str]] = None,
@@ -156,6 +157,11 @@ class ApiServer:
         self.resource_scheduler = resource_scheduler
         self.engine = engine
         self.cluster_router = cluster_router
+        #: Control-plane controller (llmq_tpu/controlplane/,
+        #: docs/controlplane.md) — None when controlplane.enabled is
+        #: false. ``__main__`` wires it after construction (the
+        #: controller needs this server's shedder).
+        self.controller = controller
         #: Process-level drain trigger (App.drain); run in a background
         #: thread by the admin route so the HTTP response isn't held
         #: hostage by the drain's in-flight wait.
@@ -258,6 +264,8 @@ class ApiServer:
         r("GET", f"{adm}/flightrecorder", self.get_flight_recorder)
         r("POST", f"{adm}/profile", self.start_profile)
         r("GET", f"{adm}/profile", self.get_profile_status)
+        r("POST", f"{adm}/controller", self.set_controller_state)
+        r("GET", f"{adm}/controller", self.get_controller_state)
         r("POST", f"{adm}/drain", self.drain_self)
         r("POST", f"{adm}/preprocessor/rules", self.add_priority_rule)
         r("GET", f"{adm}/preprocessor/rules", self.list_priority_rules)
@@ -416,6 +424,12 @@ class ApiServer:
                "time": time.time()}
         if self.engine is not None:
             out["engine"] = "running" if self.engine.running else "stopped"
+        if self.controller is not None:
+            # Paused is an OPERATOR state distinct from disabled (a
+            # disabled control plane has no controller and no field
+            # here at all) — visible to probes and peers.
+            out["controller"] = ("paused" if self.controller.paused
+                                 else "running")
         return 200, out
 
     def metrics_exposition(self, req: _Request) -> Tuple[int, Any]:
@@ -968,7 +982,13 @@ class ApiServer:
         if self.cluster_router is None:
             raise ApiError(503, "cluster router not configured "
                                 "(set cluster.peers / --peers)")
-        return 200, self.cluster_router.overview()
+        out = self.cluster_router.overview()
+        if self.controller is not None:
+            # Control-plane block (docs/controlplane.md): current rung,
+            # last action + reason, target vs live replicas, burn
+            # inputs — the operator's one-stop view.
+            out["controller"] = self.controller.snapshot()
+        return 200, out
 
     def generate_sync(self, req: _Request) -> Tuple[int, Any]:
         """Synchronous inference RPC — the server half of the
@@ -1101,6 +1121,33 @@ class ApiServer:
     def get_profile_status(self, req: _Request) -> Tuple[int, Any]:
         from llmq_tpu.observability import device
         return 200, device.profile_status()
+
+    def _require_controller(self):
+        if self.controller is None:
+            raise ApiError(503, "control plane disabled "
+                                "(set controlplane.enabled)")
+        return self.controller
+
+    def get_controller_state(self, req: _Request) -> Tuple[int, Any]:
+        """Controller snapshot (docs/controlplane.md): rung, target vs
+        live replicas, burn inputs, recovery state, action counts."""
+        return 200, self._require_controller().snapshot()
+
+    def set_controller_state(self, req: _Request) -> Tuple[int, Any]:
+        """Operator pause/resume: ``{"action": "pause"|"resume"}``.
+        Paused ≠ disabled — the controller keeps observing (snapshot
+        stays fresh, /health shows "paused") but takes no action."""
+        ctl = self._require_controller()
+        action = str(req.json().get("action", "")).strip().lower()
+        if action == "pause":
+            ctl.pause()
+        elif action == "resume":
+            ctl.resume()
+        else:
+            raise ApiError(400,
+                           f"action must be 'pause' or 'resume' "
+                           f"(got {action!r})")
+        return 200, {"status": "paused" if ctl.paused else "running"}
 
     def add_priority_rule(self, req: _Request) -> Tuple[int, Any]:
         if self.preprocessor is None:

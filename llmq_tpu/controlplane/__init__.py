@@ -1,0 +1,56 @@
+"""Self-healing control plane (docs/controlplane.md).
+
+Closes ROADMAP item 6's observe→decide→act loop: a reconciliation
+controller (:mod:`controller`) consumes the SLO burn rates, queue
+backlog, breaker/health/supervisor lifecycle and measured throughput
+the observability planes already emit, and drives replica scaling
+through a provision seam (:mod:`pool`), replacement of dead replicas
+through the existing drain/failover lifecycle, and a degradation
+ladder (:mod:`ladder`) that tightens admission at the overload seam
+before SLOs burn.
+
+``controlplane.enabled: false`` (the default) is a hard off-switch:
+:func:`build_controller` returns None and no serving path changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from llmq_tpu.controlplane.controller import (ACTIONS,  # noqa: F401
+                                              REASONS,
+                                              ReplicaController)
+from llmq_tpu.controlplane.ladder import DegradationLadder  # noqa: F401
+from llmq_tpu.controlplane.pool import (ExecReplicaPool,  # noqa: F401
+                                        LocalEnginePool, ReplicaPool,
+                                        SubprocessReplicaPool,
+                                        build_pool)
+
+
+def build_controller(cfg: Any, router: Any, *,
+                     queue_manager: Any = None,
+                     shedder: Any = None,
+                     supervisor: Any = None,
+                     pool: Optional[ReplicaPool] = None,
+                     enable_metrics: Optional[bool] = None
+                     ) -> Optional[ReplicaController]:
+    """The one wiring function: a :class:`ReplicaController` from a
+    full ``core.config.Config``, or None when ``controlplane.enabled``
+    is false (the hard off-switch — nothing is constructed at all).
+
+    ``pool`` overrides the config-built provision seam (tests and the
+    bench pass a :class:`LocalEnginePool`)."""
+    cp = getattr(cfg, "controlplane", None)
+    if cp is None or not getattr(cp, "enabled", False):
+        return None
+    if router is None:
+        return None
+    if enable_metrics is None:
+        enable_metrics = getattr(getattr(cfg, "queue", None),
+                                 "enable_metrics", True)
+    if pool is None:
+        pool = build_pool(cp.pool)
+    return ReplicaController(
+        config=cp, router=router, pool=pool,
+        queue_manager=queue_manager, shedder=shedder,
+        supervisor=supervisor, enable_metrics=enable_metrics)

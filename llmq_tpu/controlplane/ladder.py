@@ -1,0 +1,114 @@
+"""Degradation ladder: config-declared admission-tightening rungs
+(docs/controlplane.md).
+
+Scaling takes seconds (spawn, warmup); admission control takes one
+attribute write. The ladder is the control plane's fast path: while
+capacity catches up — or when there is no capacity left to add — the
+controller climbs rungs that tighten admission at the established
+overload-shedding seam (``OverloadShedder.set_degradation``), shedding
+the least valuable work first:
+
+1. tighten thresholds (shrink deadline headroom, lower the backlog
+   limit) — no request class is rejected outright yet;
+2. shed the batch tier (``low`` priority) — latency-insensitive work
+   absorbs the pressure;
+3. shed tenants below a fairness-weight bound — the tenancy registry's
+   weights are the declared value ordering.
+
+Every rung is a config-declared step (``controlplane.rungs``), climbed
+one per HOT tick and relaxed **in reverse order** only after
+``relax_after_ticks`` consecutive CALM ticks — classic hysteresis, so
+a burn rate oscillating around the threshold cannot flap admission.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("controlplane.ladder")
+
+
+class DegradationLadder:
+    def __init__(self, rungs: Optional[List[Dict[str, Any]]], *,
+                 shedder: Any = None,
+                 relax_after_ticks: int = 3) -> None:
+        self.rungs = [dict(r) for r in (rungs or [])]
+        self.shedder = shedder
+        self.relax_after_ticks = max(1, int(relax_after_ticks))
+        #: 0 = no degradation; N = rungs[N-1] active.
+        self.level = 0
+        self._calm_ticks = 0
+        self.escalations = 0
+        self.relaxations = 0
+
+    @property
+    def rung(self) -> Optional[Dict[str, Any]]:
+        if 0 < self.level <= len(self.rungs):
+            return self.rungs[self.level - 1]
+        return None
+
+    def rung_name(self) -> Optional[str]:
+        r = self.rung
+        return str(r.get("name", f"rung{self.level}")) if r else None
+
+    # -- the state machine ---------------------------------------------------
+
+    def tick(self, *, hot: bool, calm: bool) -> Optional[str]:
+        """One controller tick. ``hot``: pressure demands tightening
+        NOW. ``calm``: pressure is clearly gone. Neither: hold (and
+        reset the calm streak — relaxation needs CONSECUTIVE calm).
+        Returns "escalate"/"relax" when the level moved, else None."""
+        if hot:
+            self._calm_ticks = 0
+            if self.level < len(self.rungs):
+                self.level += 1
+                self.escalations += 1
+                self._apply()
+                log.warning("ladder escalated to rung %d (%s)",
+                            self.level, self.rung_name())
+                return "escalate"
+            return None
+        if calm:
+            self._calm_ticks += 1
+            if self.level > 0 and self._calm_ticks >= self.relax_after_ticks:
+                self.level -= 1
+                self.relaxations += 1
+                self._calm_ticks = 0
+                self._apply()
+                log.info("ladder relaxed to rung %d (%s)", self.level,
+                         self.rung_name() or "none")
+                return "relax"
+            return None
+        self._calm_ticks = 0
+        return None
+
+    def reset(self) -> None:
+        self.level = 0
+        self._calm_ticks = 0
+        self._apply()
+
+    def _apply(self) -> None:
+        shedder = self.shedder
+        if shedder is None:
+            if self.level > 0:
+                log.warning("ladder rung %d active but no shedder is "
+                            "wired (overload plane disabled?) — "
+                            "admission unchanged", self.level)
+            return
+        set_deg = getattr(shedder, "set_degradation", None)
+        if set_deg is not None:
+            set_deg(self.rung)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "level": self.level,
+            "rung": self.rung_name(),
+            "rungs": [str(r.get("name", f"rung{i + 1}"))
+                      for i, r in enumerate(self.rungs)],
+            "calm_ticks": self._calm_ticks,
+            "relax_after_ticks": self.relax_after_ticks,
+            "escalations": self.escalations,
+            "relaxations": self.relaxations,
+        }

@@ -476,6 +476,130 @@ class AsyncPipelineConfig:
                 f"(got {self.completion_workers})")
 
 
+VALID_POOL_KINDS = ("none", "subprocess", "exec")
+
+
+@dataclass
+class ReplicaPoolConfig:
+    """Provision seam for the control plane (controlplane/pool.py,
+    docs/controlplane.md): where new replicas come from when the
+    controller scales up, and how they are torn down on scale-down or
+    replacement. Part of the ``controlplane`` subsystem — its
+    off-switch is ``controlplane.enabled``."""
+    #: "none" (controller never provisions — self-healing/ladder only),
+    #: "subprocess" (spawn ``python -m llmq_tpu serve`` replicas on
+    #: this host), "exec" (run provision_cmd/decommission_cmd — the
+    #: compose/k8s hook).
+    kind: str = "none"
+    #: subprocess pool: replica N listens on ``base_port + N``.
+    base_port: int = 8200
+    #: subprocess pool: extra CLI args for the replica (e.g.
+    #: ``[--backend, echo]``).
+    args: List[str] = field(default_factory=list)
+    #: exec pool: shell command run to bring up replica N (env carries
+    #: ``LLMQ_REPLICA_SEQ``). Its LAST stdout line is the replica base
+    #: URL unless ``url_template`` is set.
+    provision_cmd: str = ""
+    #: exec pool: shell command run to tear replica N down (env carries
+    #: ``LLMQ_REPLICA_SEQ``/``LLMQ_REPLICA_ID``/``LLMQ_REPLICA_URL``).
+    decommission_cmd: str = ""
+    #: exec pool: replica base URL pattern, e.g.
+    #: ``http://llmq-replica-{seq}:8080``; overrides stdout parsing.
+    url_template: str = ""
+    #: Seconds to wait for a provisioned replica's /health to answer
+    #: before declaring the provision failed.
+    ready_timeout: float = 20.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in VALID_POOL_KINDS:
+            raise ValueError(
+                f"unknown replica pool kind {self.kind!r}; "
+                f"valid: {VALID_POOL_KINDS}")
+
+
+def default_rungs() -> List[Dict[str, Any]]:
+    """The canonical degradation ladder (docs/controlplane.md): each
+    rung tightens admission further; the controller climbs one rung per
+    hot tick and relaxes in reverse order with hysteresis.
+
+    Rung fields: ``name``; ``headroom_factor`` scales
+    ``overload.deadline_headroom`` down (shed sooner);
+    ``backlog_factor`` scales the backlog 429 threshold down;
+    ``shed_priorities`` rejects those tiers outright (batch first);
+    ``shed_tenant_weight_below`` rejects tenants whose configured
+    fairness weight is under the bound (lowest-value traffic last)."""
+    return [
+        {"name": "tighten", "headroom_factor": 0.7,
+         "backlog_factor": 0.7},
+        {"name": "shed_batch", "headroom_factor": 0.5,
+         "backlog_factor": 0.5, "shed_priorities": ["low"]},
+        {"name": "shed_low_weight", "headroom_factor": 0.4,
+         "backlog_factor": 0.4, "shed_priorities": ["low", "normal"],
+         "shed_tenant_weight_below": 1.0},
+    ]
+
+
+@dataclass
+class ControlPlaneConfig:
+    """Self-healing control plane (llmq_tpu/controlplane/,
+    docs/controlplane.md): a reconciliation controller that closes the
+    observe→decide→act loop — SLO-burn-driven scaling through the
+    replica pool, replacement of dead replicas, and a degradation
+    ladder that tightens admission before SLOs burn. ``enabled:
+    false`` (the DEFAULT) is a hard off-switch: no controller exists
+    and every serving path is byte-identical to pre-controlplane
+    behavior."""
+    enabled: bool = False
+    #: Reconcile tick period (seconds); <= 0 disables the loop thread
+    #: (ticks must then be driven manually — tests do this).
+    interval: float = 2.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: Scale up when the FAST-window SLO burn rate crosses this
+    #: (standard multi-window multi-burn-rate: 14.4x ≈ a 30-day budget
+    #: gone in 2 days — the paging threshold).
+    fast_burn_threshold: float = 14.4
+    #: Scale up when the SLOW-window burn rate crosses this (6x
+    #: sustained drains the budget well before the period ends).
+    slow_burn_threshold: float = 6.0
+    #: Queue backlog above ``backlog_per_replica × healthy replicas``
+    #: also triggers scale-up (capacity signal that leads the burn).
+    backlog_per_replica: int = 64
+    #: Minimum seconds between deliberate scale decisions (replacement
+    #: of a dead replica is exempt — healing must not wait).
+    cooldown: float = 10.0
+    #: Hard rate limit on scale/replace actions (thrash guard — the
+    #: chaos flapping scenario pins it); <= 0 disables the limit.
+    max_actions_per_minute: int = 6
+    #: Recovery budget (seconds): kill→SLO-met above this logs an
+    #: error; the chaos lane asserts recovery lands inside it.
+    recovery_budget_s: float = 30.0
+    #: Scale-down guard: keep ``(replicas - 1) × per-replica peak
+    #: tokens/s >= measured load × this`` — never drain below the
+    #: capacity the measured tokens/s requires.
+    scale_down_headroom: float = 1.5
+    #: Ladder hysteresis: escalate a rung when the fast burn rate is
+    #: at/above this (1.0 = budget being spent exactly at the allowed
+    #: rate — act BEFORE the paging threshold)…
+    escalate_burn: float = 1.0
+    #: …and relax one rung only after ``relax_after_ticks`` consecutive
+    #: ticks with fast burn at/below this.
+    relax_burn: float = 0.5
+    relax_after_ticks: int = 3
+    #: Degradation ladder rungs, mildest first (see
+    #: :func:`default_rungs` for the field reference).
+    rungs: List[Dict[str, Any]] = field(default_factory=default_rungs)
+    #: Provision seam (controlplane/pool.py).
+    pool: ReplicaPoolConfig = field(default_factory=ReplicaPoolConfig)
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError("controlplane.min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "controlplane.max_replicas must be >= min_replicas")
+
+
 @dataclass
 class SupervisorConfig:
     """Engine crash supervisor (engine/supervisor.py,
@@ -637,6 +761,8 @@ class Config:
         default_factory=ObservabilityConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    controlplane: ControlPlaneConfig = field(
+        default_factory=ControlPlaneConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     executor: ExecutorConfig = field(default_factory=ExecutorConfig)

@@ -90,7 +90,21 @@ LABEL_CONTRACT = {
                          # tenant_quota_rejections_total
                          # (tenancy.registry.QUOTA_REASONS).
                          "tenant_quota", "rate", "queue_depth",
-                         "inflight"}),
+                         "inflight",
+                         # control plane (llmq_tpu/controlplane/):
+                         # controller_actions_total reasons, plus
+                         # "degraded" on requests_shed_total (the
+                         # ladder's admission rejections).
+                         "burn_fast", "burn_slow", "replica_dead",
+                         "breaker_open", "rate_limited", "cooldown",
+                         "recovered", "idle", "operator", "capacity",
+                         "degraded"}),
+    # Control plane (llmq_tpu/controlplane/controller.py): what the
+    # reconcile loop did. Closed enum — the cardinality guard rejects
+    # any action outside it.
+    "action": frozenset({"scale_up", "scale_down", "replace",
+                         "escalate", "relax", "pause", "resume",
+                         "skip"}),
     "path": frozenset({"mixed", "program"}),
     "point": None,      # compiled-in chaos fault points (fnmatch keys)
     "kind": frozenset({"error", "timeout", "partial", "oserror",
@@ -435,6 +449,39 @@ class QueueMetrics:
             f"{ns}_tenant_inflight",
             "Dispatched (popped, unfinished) messages per tenant",
             ["tenant"], registry=registry)
+        # Control plane (llmq_tpu/controlplane/, docs/controlplane.md):
+        # the reconcile loop's actions and state. Incremented on the
+        # controller tick (2s cadence — not a hot path, no deferred
+        # flush needed).
+        self.controller_actions = Counter(
+            f"{ns}_controller_actions_total",
+            "Control-plane reconcile actions (scale_up/scale_down/"
+            "replace/escalate/relax/pause/resume; skip = an action the "
+            "rate limit or cooldown suppressed)", ["action", "reason"],
+            registry=registry)
+        self.controller_rung = Gauge(
+            f"{ns}_controller_rung",
+            "Active degradation-ladder rung (0 = no degradation)",
+            registry=registry)
+        self.controller_target_replicas = Gauge(
+            f"{ns}_controller_target_replicas",
+            "Replica count the controller is reconciling toward",
+            registry=registry)
+        self.controller_live_replicas = Gauge(
+            f"{ns}_controller_live_replicas",
+            "Healthy/degraded replicas the controller observes",
+            registry=registry)
+        self.controller_recovery_seconds = Histogram(
+            f"{ns}_controller_recovery_seconds",
+            "Replica-loss recovery time: first replacement action "
+            "until the cluster is back at target with SLO burn < 1",
+            buckets=(0.5, 1, 2.5, 5, 10, 20, 30, 60, 120, 300),
+            registry=registry)
+        self.controller_paused = Gauge(
+            f"{ns}_controller_paused",
+            "1 while an operator has paused the controller "
+            "(distinct from controlplane.enabled=false)",
+            registry=registry)
         # SLO layer (llmq_tpu/observability/slo.py): burn rate 1.0 =
         # spending exactly the allowed error budget over the window.
         self.slo_burn_rate = Gauge(

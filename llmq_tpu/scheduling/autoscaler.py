@@ -60,7 +60,7 @@ class Autoscaler:
         provision_fn: Optional[ProvisionFn] = None,
         decommission_fn: Optional[DecommissionFn] = None,
         clock: Optional[Clock] = None,
-        localtime_fn: Callable[[], time.struct_time] = time.localtime,
+        localtime_fn: Optional[Callable[[], time.struct_time]] = None,
     ) -> None:
         self.queue_manager = queue_manager
         self.load_balancer = load_balancer
@@ -69,7 +69,14 @@ class Autoscaler:
         self._provision = provision_fn
         self._decommission = decommission_fn
         self._clock = clock or SYSTEM_CLOCK
-        self._localtime = localtime_fn
+        # Clock discipline: the adaptive time-of-day strategy derives
+        # local time FROM the injected clock (time.localtime(epoch) is
+        # a pure conversion, not a wall-clock read), so FakeClock tests
+        # drive scaling decisions deterministically. An explicit
+        # localtime_fn still overrides (tests pin exact struct_times).
+        self._localtime = (localtime_fn
+                           or (lambda: time.localtime(
+                               self._clock.now())))
         self._last_scale_at = 0.0
         self._seq = 0
         self._stop = threading.Event()

@@ -352,6 +352,9 @@ class OffSwitchCheck(Check):
         "SchedulerConfig", "ResourceSchedulerConfig", "LoadBalancerConfig",
         "ConversationConfig", "LoggingConfig", "ModelConfig",
         "ExecutorConfig", "TPUConfig", "TenantClassConfig",
+        # Part of the controlplane subsystem; its off-switch is
+        # controlplane.enabled (a pool has no independent "off").
+        "ReplicaPoolConfig",
     }
 
     def run(self, repo: Repo) -> List[Finding]:
