@@ -13,6 +13,10 @@ Extra fields:
 - ``tenancy``: two-tenant 4:1-weight isolation against the echo engine
   (docs/tenancy.md) — achieved token share under saturation and the
   victim tenant's realtime p99 with and without an aggressor burst.
+- ``kv_tiering``: tiered-KV residency A/B against the echo engine
+  (docs/tiering.md) — resident warm conversations with a small KV pool
+  HBM-only vs the HBM → host → store hierarchy, realtime p99 per rate
+  point for both, hit-tier breakdown, host-tier first-token delta.
 - ``controlplane``: 4× traffic ramp A/B (docs/controlplane.md) —
   static 4-replica profile vs controller-managed, reporting realtime
   p99, replica-seconds consumed and the waste decomposition for both.
@@ -45,7 +49,9 @@ LLMQ_BENCH_MIXED_BUDGET / LLMQ_BENCH_MIXED_SLICES,
 LLMQ_BENCH_TENANCY_RATE / LLMQ_BENCH_TENANCY_SECS (victim offered rate
 and per-phase duration for the tenancy isolation section),
 LLMQ_BENCH_CONTROLPLANE_RATE / LLMQ_BENCH_CONTROLPLANE_SECS (base
-offered rate and per-phase duration for the control-plane ramp A/B).
+offered rate and per-phase duration for the control-plane ramp A/B),
+LLMQ_BENCH_KV_TIER_CONVS / LLMQ_BENCH_KV_TIER_SECS (conversation count
+and per-rate-point duration for the tiered-KV residency A/B).
 """
 
 from __future__ import annotations
@@ -730,6 +736,204 @@ def _enable_bench_cache() -> None:
     cache = os.environ.get("LLMQ_BENCH_CACHE_DIR",
                            os.path.join(REPO, ".jax_cache"))
     enable_compilation_cache(cache)
+
+
+def bench_kv_tiering(n_convs: int = 640, rates=(50.0, 150.0),
+                     phase_s: float = 2.5) -> Dict:
+    """Tiered-KV residency A/B against the echo engine
+    (docs/tiering.md): how many conversations a replica keeps WARM
+    with a deliberately small KV pool, HBM-only vs the full
+    HBM → host → store hierarchy.
+
+    Both modes seed ``n_convs`` conversations (first turns) against a
+    pool sized for roughly a tenth of them, then drive Poisson
+    re-arrival traffic uniformly over ALL of them at each rate point:
+
+    - **hbm_only** — pins LRU-reclaim as the pool fills; only the most
+      recent conversations stay warm, the rest re-prefill from
+      scratch (``history_text`` replay — the pre-tiering reality).
+    - **tiering** — reclaimed pins demote to the host tier (and the
+      pin TTL is forced to expire everything once, so the measured
+      phase is promotion-driven, not pin-hit-driven); re-arrivals
+      promote back behind admission.
+
+    Reports resident-conversation counts (the ≥10× gate), realtime
+    p99 per rate point for both modes (the equal-p99 gate), the
+    hit-tier breakdown per rate point, and the host-tier first-token
+    p99 delta vs an HBM pin hit (the promote-latency-hidden gate,
+    < 15%)."""
+    from llmq_tpu.core.config import KVTieringConfig
+    from llmq_tpu.engine import (ByteTokenizer, EchoExecutor, GenRequest,
+                                 InferenceEngine)
+
+    PAGE, POOL = 16, 257        # 256 allocatable pages
+    TURN_TOKENS = 8
+
+    def build(tiering: bool) -> InferenceEngine:
+        tok = ByteTokenizer()
+        # 1 ms simulated device per chunk: realistic chunk cadence so
+        # the first-token comparison (promote-hidden gate) measures
+        # scheduling, not scheduler jitter at the µs scale.
+        ex = EchoExecutor(batch_size=16, page_size=PAGE, num_pages=POOL,
+                          max_pages_per_seq=8, eos_id=tok.eos_id,
+                          chunk_size=4, step_delay_s=0.001)
+        return InferenceEngine(
+            ex, tok, enable_metrics=False,
+            name="kvtier" if tiering else "kvtier_off",
+            max_decode_steps=TURN_TOKENS, kv_pin_ttl=600.0,
+            kv_tiering=(KVTieringConfig(
+                enabled=True, host_max_conversations=4 * n_convs)
+                if tiering else None))
+
+    def prompt_of(cid: int) -> str:
+        # ~40 tokens + generation ≈ 3-4 pinned pages per conversation.
+        return f"conversation {cid} " + "payload words " * 2
+
+    def seed(eng: InferenceEngine) -> None:
+        # The engine loop is running — wait on handles, never step.
+        handles = []
+        for cid in range(n_convs):
+            handles.append(eng.submit(GenRequest(
+                id=f"seed-{cid}", prompt=prompt_of(cid),
+                conversation_id=f"conv-{cid}",
+                priority=Priority.REALTIME,
+                max_new_tokens=TURN_TOKENS)))
+        for h in handles:
+            assert h.wait(120.0), "seed turn stalled"
+
+    def expire_all(eng: InferenceEngine) -> None:
+        """Force every pin through the demotion path so the measured
+        phase exercises promotion, not residual pins."""
+        eng.kv_pin_ttl = 1e-6
+        deadline = time.perf_counter() + 10.0
+        while eng.cached_conversations() and time.perf_counter() < deadline:
+            eng._wake.set()          # the loop's own step expires pins
+            time.sleep(0.002)
+        eng.kv_pin_ttl = 600.0
+        if eng._tiering is not None:
+            while (sum(eng._tiering.counts().values()) < n_convs
+                   and time.perf_counter() < deadline):
+                time.sleep(0.005)
+
+    def traffic(eng: InferenceEngine, label: str, rate: float,
+                secs: float, turn: List[int]) -> Dict:
+        # Half the re-arrivals hit a hot 32-conversation subset (those
+        # stay pinned after their first return → HBM hits), the rest
+        # spread uniformly over the long tail (host-tier promotions) —
+        # the realistic mix, and it gives the promote-hidden gate
+        # comparable per-tier sample sizes within ONE workload.
+        rng = random.Random(42)
+        hot = min(32, n_convs)
+        handles = []
+        nxt = time.perf_counter()
+        t_end = time.perf_counter() + secs
+        n = 0
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            if now < nxt:
+                time.sleep(min(0.001, nxt - now))
+                continue
+            nxt += rng.expovariate(rate)
+            cid = (rng.randrange(hot) if rng.random() < 0.5
+                   else rng.randrange(n_convs))
+            turn[0] += 1
+            handles.append(eng.submit(GenRequest(
+                id=f"{label}-{n}", prompt=f" turn {turn[0]} more",
+                conversation_id=f"conv-{cid}",
+                priority=Priority.REALTIME,
+                max_new_tokens=TURN_TOKENS)))
+            n += 1
+        lat, ft, warm = [], [], 0
+        ft_by_tier: Dict[str, List[float]] = {}
+        for h in handles:
+            assert h.wait(60.0), "re-arrival stalled"
+            lat.append((h.finished_at - h.submitted_at) * 1e3)
+            mark = h.marks.get("first_token")
+            if mark is not None:
+                ft_ms = (mark - h.submitted_at) * 1e3
+                ft.append(ft_ms)
+                tier = h.result.kv_tier
+                if tier:
+                    ft_by_tier.setdefault(tier, []).append(ft_ms)
+            if h.result.cached_tokens > 0:
+                warm += 1
+        return {"n": n, "p99_ms": round(pctl(lat, 0.99), 2),
+                "first_token_p50_ms": round(pctl(ft, 0.50), 2),
+                "first_token_p99_ms": round(pctl(ft, 0.99), 2),
+                "warm_fraction": round(warm / n, 4) if n else 0.0,
+                "_ft_by_tier": ft_by_tier}
+
+    out: Dict = {"conversations": n_convs,
+                 "pool_pages": POOL - 1, "page_size": PAGE}
+    hit_keys = ("hbm", "host", "store", "recompute")
+    for mode in ("hbm_only", "tiering"):
+        tiering = mode == "tiering"
+        eng = build(tiering)
+        eng.start()
+        turn = [1]
+        log(f"[kv_tiering] {mode}: seeding {n_convs} conversations "
+            f"over a {POOL - 1}-page pool ...")
+        seed(eng)
+        res: Dict = {"resident_after_seed":
+                     len(eng.cached_conversations())}
+        if tiering:
+            expire_all(eng)
+            counts = eng._tiering.counts()
+            res["resident_demoted"] = {
+                "host": counts["host"], "store": counts["store"],
+                "recompute": counts["recompute"]}
+            resident = (len(eng.cached_conversations())
+                        + counts["host"] + counts["store"])
+        else:
+            resident = len(eng.cached_conversations())
+        res["resident_conversations"] = resident
+        res["points"] = []
+        ft_by_tier: Dict[str, List[float]] = {}
+        for rate in rates:
+            stats0 = (dict(eng._tiering.hits) if tiering else None)
+            point = traffic(eng, f"{mode}-{rate:g}", rate, phase_s,
+                            turn)
+            for tier, xs in point.pop("_ft_by_tier").items():
+                ft_by_tier.setdefault(tier, []).extend(xs)
+            point["rate_per_s"] = rate
+            if tiering:
+                hits = {k: eng._tiering.hits.get(k, 0)
+                        - stats0.get(k, 0) for k in hit_keys}
+                point["tier_hits"] = hits
+            res["points"].append(point)
+            log(f"[kv_tiering] {mode} @{rate:g}/s: p99="
+                f"{point['p99_ms']}ms warm={point['warm_fraction']}"
+                + (f" tiers={point.get('tier_hits')}" if tiering
+                   else ""))
+        if tiering:
+            # Promote-latency-hidden gate, measured WITHIN the same
+            # traffic: first-token p99 of host-tier promotions vs pure
+            # HBM pin hits (a conversation re-arriving twice is pinned
+            # again the second time — same workload, same rates).
+            res["first_token_by_tier"] = {
+                t: {"n": len(xs),
+                    "p50_ms": round(pctl(xs, 0.50), 2),
+                    "p99_ms": round(pctl(xs, 0.99), 2)}
+                for t, xs in sorted(ft_by_tier.items())}
+            hbm_ft = pctl(ft_by_tier.get("hbm", []), 0.99)
+            host_ft = pctl(ft_by_tier.get("host", []), 0.99)
+            if hbm_ft > 0 and host_ft > 0:
+                res["host_first_token_delta_pct"] = round(
+                    (host_ft - hbm_ft) / hbm_ft * 100.0, 1)
+        eng.stop()
+        out[mode] = res
+    off_res = out["hbm_only"]["resident_conversations"]
+    on_res = out["tiering"]["resident_conversations"]
+    out["resident_multiplier"] = round(on_res / max(1, off_res), 2)
+    out["p99_ratio_at_rates"] = [
+        round(t["p99_ms"] / max(0.01, o["p99_ms"]), 3)
+        for t, o in zip(out["tiering"]["points"],
+                        out["hbm_only"]["points"])]
+    log(f"[kv_tiering] resident {off_res} → {on_res} "
+        f"({out['resident_multiplier']}×), p99 ratios "
+        f"{out['p99_ratio_at_rates']}, host first-token delta "
+        f"{out['tiering'].get('host_first_token_delta_pct')}%")
+    return out
 
 
 def bench_tpu_decode(model_name: str, batch: int, steps: int,
@@ -1579,6 +1783,16 @@ def main() -> None:
                                             "4")))
     except Exception as e:  # noqa: BLE001
         log(f"[tenancy] isolation bench failed: {type(e).__name__}: {e}")
+    kv_tiering_res = None
+    try:
+        kv_tiering_res = bench_kv_tiering(
+            n_convs=int(os.environ.get("LLMQ_BENCH_KV_TIER_CONVS",
+                                       "640")),
+            phase_s=float(os.environ.get("LLMQ_BENCH_KV_TIER_SECS",
+                                         "2.5")))
+    except Exception as e:  # noqa: BLE001
+        log(f"[kv_tiering] residency bench failed: "
+            f"{type(e).__name__}: {e}")
     controlplane_res = None
     try:
         controlplane_res = bench_controlplane_ramp(
@@ -1623,6 +1837,7 @@ def main() -> None:
         "queue": qres,
         "tiers": tiers,
         "tenancy": tenancy_res,
+        "kv_tiering": kv_tiering_res,
         "controlplane": controlplane_res,
         "tpu": tpu,
         "tpu_tiers": tpu_tiers,
@@ -1636,6 +1851,11 @@ def main() -> None:
                 (tenancy_res or {}).get("achieved_share_a_to_b"),
             "tenant_victim_p99_delta_pct":
                 (tenancy_res or {}).get("victim_p99_delta_pct"),
+            "kv_tier_resident_multiplier":
+                (kv_tiering_res or {}).get("resident_multiplier"),
+            "kv_tier_host_first_token_delta_pct":
+                ((kv_tiering_res or {}).get("tiering") or {})
+                .get("host_first_token_delta_pct"),
             "controller_replica_seconds_saved_pct":
                 (controlplane_res or {}).get("replica_seconds_saved_pct"),
             "controller_realtime_p99_ms":

@@ -244,6 +244,21 @@ class ClusterRouter(EngineRouter):
                 last_err = CircuitOpenError(
                     ep.id, self.breakers.breaker(ep.id).retry_in())
                 continue
+            if session is not None:
+                # Tiered-KV prefetch (docs/tiering.md): placement just
+                # resolved — the affinity signal ("this conversation is
+                # coming back HERE") is exactly the promotion trigger,
+                # so a local engine starts pulling a store-tier entry
+                # toward the host before the dispatch even lands.
+                # Remote engines (HttpEngineClient) lack the seam; the
+                # replica's own submit-path prepare covers them.
+                hint = getattr(engine, "hint_arrival", None)
+                if hint is not None:
+                    try:
+                        hint(session)
+                    except Exception:  # noqa: BLE001 — a hint only
+                        log.exception("arrival hint failed for %s",
+                                      session)
             observability.record(msg.id, "dispatched", endpoint=ep.id,
                                  reason=reason,
                                  priority=msg.priority.tier_name)

@@ -41,9 +41,21 @@ class ConversationStore(Protocol):
     def close(self) -> None: ...
 
 
+class KVPayloadStore(Protocol):
+    """Spill-tier seam (llmq_tpu/tiering/, docs/tiering.md): opaque
+    serialized KV page payloads keyed by conversation id. The tiering
+    plane feature-detects these methods — a store without them simply
+    disables the store tier. All three backends below implement it."""
+
+    def save_kv(self, conversation_id: str, blob: bytes) -> None: ...
+    def load_kv(self, conversation_id: str) -> Optional[bytes]: ...
+    def delete_kv(self, conversation_id: str) -> None: ...
+
+
 class InMemoryStore:
     def __init__(self) -> None:
         self._data: Dict[str, dict] = {}
+        self._kv: Dict[str, bytes] = {}
         self._mu = threading.Lock()
 
     def save(self, conversation: Conversation) -> None:
@@ -63,6 +75,21 @@ class InMemoryStore:
     def delete(self, conversation_id: str) -> None:
         with self._mu:
             self._data.pop(conversation_id, None)
+            self._kv.pop(conversation_id, None)
+
+    # -- KV payload seam (tiering spill tier) --------------------------------
+
+    def save_kv(self, conversation_id: str, blob: bytes) -> None:
+        with self._mu:
+            self._kv[conversation_id] = bytes(blob)
+
+    def load_kv(self, conversation_id: str) -> Optional[bytes]:
+        with self._mu:
+            return self._kv.get(conversation_id)
+
+    def delete_kv(self, conversation_id: str) -> None:
+        with self._mu:
+            self._kv.pop(conversation_id, None)
 
     def close(self) -> None:
         pass
@@ -71,7 +98,17 @@ class InMemoryStore:
 class SqliteStore:
     """Durable store; schema mirrors the reference's GORM
     ConversationModel (persistence.go:170-196): one row per conversation
-    with JSON messages/metadata columns."""
+    with JSON messages/metadata columns.
+
+    Hardened for the tiering plane's spill tier (docs/tiering.md):
+    WAL journal mode so the plane's worker-thread writes never block
+    the state manager's reads, a ``busy_timeout`` so a briefly-held
+    writer lock queues instead of raising ``database is locked``
+    (pinned by a 4-thread concurrency test), and a BLOB-safe
+    ``kv_payloads`` table created by idempotent migration — an
+    existing pre-tiering database upgrades in place on open."""
+
+    _BUSY_TIMEOUT_MS = 10_000
 
     def __init__(self, path: str = "llmq_state.db") -> None:
         self._path = path
@@ -83,6 +120,10 @@ class SqliteStore:
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=10.0)
             conn.execute("PRAGMA journal_mode=WAL")
+            # Belt to the connect-timeout braces: the sqlite3 module's
+            # ``timeout`` only covers the initial lock wait; statements
+            # inside an open transaction need the PRAGMA.
+            conn.execute(f"PRAGMA busy_timeout={self._BUSY_TIMEOUT_MS}")
             self._local.conn = conn
         return conn
 
@@ -104,6 +145,17 @@ class SqliteStore:
             conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_conv_user "
                 "ON conversations(user_id)")
+            # Migration (idempotent): the tiering plane's spill tier.
+            # Payloads are opaque BLOBs (tiering/plane.py encode_blob —
+            # serialized page payloads incl. int8 scale pools); sqlite
+            # stores them byte-faithfully, no text coercion.
+            conn.execute(
+                """CREATE TABLE IF NOT EXISTS kv_payloads (
+                    conversation_id TEXT PRIMARY KEY,
+                    payload BLOB NOT NULL,
+                    nbytes INTEGER NOT NULL,
+                    updated_at REAL NOT NULL
+                )""")
 
     def save(self, conversation: Conversation) -> None:
         d = conversation.to_dict()
@@ -150,6 +202,42 @@ class SqliteStore:
         with conn:
             conn.execute("DELETE FROM conversations WHERE id=?",
                          (conversation_id,))
+            conn.execute(
+                "DELETE FROM kv_payloads WHERE conversation_id=?",
+                (conversation_id,))
+
+    # -- KV payload seam (tiering spill tier) --------------------------------
+
+    def save_kv(self, conversation_id: str, blob: bytes) -> None:
+        # lint: allow-wallclock — row timestamp for operator forensics
+        # only; nothing schedules off it.
+        import time
+
+        conn = self._conn()
+        with conn:
+            conn.execute(
+                """INSERT INTO kv_payloads
+                   (conversation_id, payload, nbytes, updated_at)
+                   VALUES (?,?,?,?)
+                   ON CONFLICT(conversation_id) DO UPDATE SET
+                     payload=excluded.payload, nbytes=excluded.nbytes,
+                     updated_at=excluded.updated_at""",
+                (conversation_id, sqlite3.Binary(bytes(blob)),
+                 len(blob), time.time()))
+
+    def load_kv(self, conversation_id: str) -> Optional[bytes]:
+        cur = self._conn().execute(
+            "SELECT payload FROM kv_payloads WHERE conversation_id=?",
+            (conversation_id,))
+        row = cur.fetchone()
+        return bytes(row[0]) if row is not None else None
+
+    def delete_kv(self, conversation_id: str) -> None:
+        conn = self._conn()
+        with conn:
+            conn.execute(
+                "DELETE FROM kv_payloads WHERE conversation_id=?",
+                (conversation_id,))
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)
@@ -214,9 +302,26 @@ class RedisStore:
         conv = self.load(conversation_id)
         pipe = self._r.pipeline()
         pipe.delete(self._key(conversation_id))
+        pipe.delete(self._kvkey(conversation_id))
         if conv is not None:
             pipe.srem(self._ukey(conv.user_id), conversation_id)
         pipe.execute()
+
+    # -- KV payload seam (tiering spill tier) --------------------------------
+
+    def _kvkey(self, cid: str) -> str:
+        return f"{self._prefix}kv:{cid}"
+
+    def save_kv(self, conversation_id: str, blob: bytes) -> None:
+        self._r.set(self._kvkey(conversation_id), bytes(blob),
+                    ex=self._ttl)
+
+    def load_kv(self, conversation_id: str) -> Optional[bytes]:
+        blob = self._r.get(self._kvkey(conversation_id))
+        return bytes(blob) if blob is not None else None
+
+    def delete_kv(self, conversation_id: str) -> None:
+        self._r.delete(self._kvkey(conversation_id))
 
     def close(self) -> None:
         self._r.close()

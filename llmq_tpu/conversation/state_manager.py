@@ -63,6 +63,13 @@ class StateManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    @property
+    def store(self) -> ConversationStore:
+        """The backing store (public seam: the tiering plane spills KV
+        payloads through the same store's ``save_kv``/``load_kv``
+        methods when it implements them — persistence.KVPayloadStore)."""
+        return self._store
+
     # -- KV pinning hooks ----------------------------------------------------
 
     def on_touch(self, cb: Callable[[Conversation], None]) -> None:
@@ -98,12 +105,36 @@ class StateManager:
         scheduling thread, and a slow store would stall decode. The
         handle describes volatile HBM state anyway — it rides along the
         next regular save. Returns False if the conversation is unknown
-        here."""
+        here.
+
+        The handle's optional ``tier`` field tracks where the prefix
+        currently lives ("hbm" at record time; the engine moves it to
+        "host"/"store" on demotion, "dropped" when the KV is gone for
+        good — see :meth:`update_prefix_handle_tier`). Consumers sizing
+        prefill work (``InferenceEngine.prefill_estimate``) treat
+        "dropped" as non-cached and everything else as promotable."""
         with self._mu:
             conv = self._convs.get(conversation_id)
             if conv is None:
                 return False
             conv.metadata["prefix_kv"] = dict(handle)
+        return True
+
+    def update_prefix_handle_tier(self, conversation_id: str,
+                                  tier: str) -> bool:
+        """Move a recorded prefix handle's ``tier`` field (tiering
+        plane bookkeeping: "hbm" | "host" | "store" | "dropped"). The
+        handle itself — length/pages, the content identity — is
+        untouched: it may outlive HBM residency by design. Returns
+        False when the conversation or handle is unknown."""
+        with self._mu:
+            conv = self._convs.get(conversation_id)
+            if conv is None:
+                return False
+            h = conv.metadata.get("prefix_kv")
+            if not isinstance(h, dict):
+                return False
+            h["tier"] = tier
         return True
 
     def record_placement(self, conversation_id: str, endpoint_id: str,

@@ -671,6 +671,44 @@ class PrefixCacheConfig:
 
 
 @dataclass
+class KVTieringConfig:
+    """Tiered KV plane (llmq_tpu/tiering/, docs/tiering.md): HBM →
+    host-DRAM → conversation-store hierarchy under the radix prefix
+    cache and the conversation pins. Cold pinned/prefix KV demotes to
+    preallocated host buffers instead of dying with its pin, promotes
+    back with async prefetch at conversation re-arrival, and the
+    coldest entries spill to the conversation store — recompute from
+    the remembered token stream is the final fallback. ``enabled:
+    false`` (the DEFAULT) is a hard off-switch: no plane, no worker
+    thread, byte-identical HBM-only behavior."""
+    enabled: bool = False
+    #: Pinned host-DRAM budget for demoted page payloads (MiB). The
+    #: pool is preallocated page-granular buffers (HostStaging's
+    #: churn-kill discipline); content-free backends (echo) hold
+    #: metadata-only entries bounded by ``host_max_conversations``.
+    host_capacity_mb: int = 256
+    #: Cap on conversations resident in the host tier (payload or
+    #: metadata-only); the coldest spill to the store past it.
+    host_max_conversations: int = 4096
+    #: Spill the coldest host-tier entries to the conversation store
+    #: (persistence.py KV-payload seam). Off → past-capacity entries
+    #: fall back to recompute instead.
+    store_spill: bool = True
+    #: Seconds a promotion may wait on an in-flight extract/store load
+    #: before admission falls back to recompute-from-tokens.
+    promote_timeout_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.host_capacity_mb < 0:
+            raise ValueError("kv_tiering.host_capacity_mb must be >= 0")
+        if self.host_max_conversations < 1:
+            raise ValueError(
+                "kv_tiering.host_max_conversations must be >= 1")
+        if self.promote_timeout_s <= 0:
+            raise ValueError("kv_tiering.promote_timeout_s must be > 0")
+
+
+@dataclass
 class MixedBatchConfig:
     """Token-budget mixed prefill+decode batching (docs/architecture.md
     "Mixed step"). When pending prefill work coexists with active decode
@@ -725,6 +763,7 @@ class ExecutorConfig:
     preemption: bool = True
     kv_pin_ttl: float = 600.0           # per-conversation KV pin TTL in HBM
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    kv_tiering: KVTieringConfig = field(default_factory=KVTieringConfig)
     mixed_batch: MixedBatchConfig = field(default_factory=MixedBatchConfig)
     async_pipeline: AsyncPipelineConfig = field(
         default_factory=AsyncPipelineConfig)

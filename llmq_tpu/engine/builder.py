@@ -155,12 +155,17 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         tier_max_wait=tier_max_wait,
         prefix_cache=getattr(ex, "prefix_cache", None),
         mixed_batch=mixed,
-        async_pipeline=pipe)
+        async_pipeline=pipe,
+        kv_tiering=getattr(ex, "kv_tiering", None))
+    tier = getattr(ex, "kv_tiering", None)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
-             "prefix_cache=%s mixed_batch=%s async_pipeline=%s)",
+             "prefix_cache=%s mixed_batch=%s async_pipeline=%s "
+             "kv_tiering=%s)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
              "on" if getattr(ex.prefix_cache, "enabled", False) else "off",
              (f"on(budget={mixed.prefill_token_budget}"
               f"x{mixed_slices})" if mixed_on else "off"),
-             (f"on(depth={pipe.depth})" if pipe_on else "off"))
+             (f"on(depth={pipe.depth})" if pipe_on else "off"),
+             (f"on(host={tier.host_capacity_mb}MiB)"
+              if getattr(tier, "enabled", False) else "off"))
     return engine

@@ -164,6 +164,10 @@ class GenResult:
     cached_tokens: int = 0       # KV reused from the conversation cache
     finish_reason: str = ""      # eos | length | cancelled | error
     error: str = ""
+    #: Which KV tier served this request's conversation re-arrival
+    #: (docs/tiering.md): "hbm" | "host" | "store" | "recompute"; ""
+    #: when the tiering plane is off or no cached state was involved.
+    kv_tier: str = ""
 
 
 class GenHandle:
@@ -241,7 +245,7 @@ class _Sequence:
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
                  "first_handle", "eff_prio", "arrival", "prefix_match",
                  "reuse_counted", "mixed_pending", "pf_tokens_run",
-                 "usage", "pending_emit")
+                 "usage", "pending_emit", "served_tier")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -311,6 +315,9 @@ class _Sequence:
         #: the completion executor — SSE framing never runs on the
         #: step-dispatch path. Always empty with the pipeline off.
         self.pending_emit: List[int] = []
+        #: KV tier that served this re-arrival (tiering plane only;
+        #: "" otherwise) — lands on GenResult.kv_tier.
+        self.served_tier = ""
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -451,6 +458,7 @@ class InferenceEngine:
         prefix_cache=None,
         mixed_batch=None,
         async_pipeline=None,
+        kv_tiering=None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -605,6 +613,37 @@ class InferenceEngine:
         #: see _offload_fetch for why chunk and resolve lanes are
         #: separate.
         self._fetch_lanes: Dict[str, tuple] = {}
+        #: Tiered KV plane (llmq_tpu/tiering/, docs/tiering.md):
+        #: HBM → host-DRAM → store hierarchy under the pins and the
+        #: radix tree. ``kv_tiering`` accepts a
+        #: core.config.KVTieringConfig or anything with its fields;
+        #: None/disabled (the default) keeps the exact HBM-only
+        #: behavior — every tiering call site below is one None check.
+        self._tiering = None
+        if kv_tiering is not None and getattr(kv_tiering, "enabled",
+                                              False):
+            from llmq_tpu.tiering import KVTieringPlane
+            self._tiering = KVTieringPlane(
+                kv_tiering, name, executor, clock=self._clock,
+                metrics=enable_metrics,
+                # A finished extract/load wakes the loop so a pending
+                # promotion's admission retries immediately.
+                on_ready=self._wake.set)
+            _eng_tier_ref = weakref.ref(self)
+
+            def _hbm_tier():
+                eng = _eng_tier_ref()
+                if eng is None or eng._tiering is None:
+                    return None
+                n = eng.allocator.pinned_pages()
+                return n, n * eng._tiering.pool.page_nbytes
+
+            self._tiering.hbm_provider = _hbm_tier
+        #: Prefix-handle tier notes deferred out of self._mu (the
+        #: state manager's lock sits ABOVE the engine's — updating the
+        #: handle under _mu would invert the order). Engine-thread
+        #: only; flushed right after the lock drops.
+        self._pending_tier_notes: List = []
         self.steps = 0
         #: Device/tunnel stall accounting (bench satellite: BENCH rate
         #: points carry these as deltas so a poisoned latency point is
@@ -651,6 +690,11 @@ class InferenceEngine:
                         self.spec.max_pages_per_seq)
         if self._usage.enabled:
             seq.usage = RequestUsage()
+        if self._tiering is not None and req.conversation_id:
+            # Re-arrival prefetch (docs/tiering.md): a store-tier
+            # entry's blob starts loading NOW, overlapping queue wait
+            # and admission instead of serializing with them.
+            self._tiering.prepare(req.conversation_id)
         with self._mu:
             self._inbox.append(seq)
         self._wake.set()
@@ -722,6 +766,43 @@ class InferenceEngine:
         #: eviction hooks under its own lock, so the lock order is
         #: strictly state-manager → engine.
         self._state_manager = state_manager
+        if self._tiering is not None:
+            if self._tiering.store is None:
+                # Spill-tier wiring (docs/tiering.md): the tiering
+                # plane reuses the conversation store's KV-payload
+                # seam when the backend implements it (sqlite/memory/
+                # redis all do); a store without it simply disables
+                # the store tier.
+                store = getattr(state_manager, "store", None)
+                if store is not None and hasattr(store, "save_kv"):
+                    self._tiering.store = store
+            # Worker-side degradations (failed extract/spill/load,
+            # bound drops) downgrade the prefix handle, so
+            # prefill_estimate never promises a prefix nothing can
+            # serve. Fired with no plane lock held; takes only the
+            # state manager's lock — no ordering cycle.
+            self._tiering.on_tier_change = self._tier_changed_cb
+
+    def _tier_changed_cb(self, conversation_id: str, tier: str) -> None:
+        """Tiering-plane callback (worker thread): forward an
+        asynchronous tier change to the recorded prefix handle."""
+        sm = self._state_manager
+        if sm is None:
+            return
+        try:
+            sm.update_prefix_handle_tier(conversation_id, tier)
+        except Exception:  # noqa: BLE001 — bookkeeping, not a gate
+            log.exception("prefix-handle tier update failed for %s",
+                          conversation_id)
+
+    def hint_arrival(self, conversation_id: str) -> None:
+        """Prefetch hint from outside the engine (any thread): the
+        cluster router's affinity pass calls this the moment placement
+        resolves to this replica — ``record_placement`` says who is
+        coming back, and a store-tier conversation starts its blob
+        load before the request even finishes dispatch."""
+        if self._tiering is not None and conversation_id:
+            self._tiering.prepare(conversation_id)
 
     def touch_conversation(self, conv_id: str) -> None:
         with self._mu:
@@ -745,9 +826,39 @@ class InferenceEngine:
         if kv is not None:
             self.allocator.unpin(conv_id)
             if self._usage.enabled:
+                # The HBM pin's page-second meter closes HERE — at
+                # demotion too: host/store residency is not the priced
+                # HBM resource, so billing ends when the pages leave
+                # the pool (pinned by tests/test_kv_tiering.py).
                 self._usage.unpin_kv(conv_id)
+            if not invalidate and self._tiering is not None:
+                # Demote instead of dying: the plane dispatches the
+                # payload gather (device FIFO order makes the free
+                # below safe — the gather reads the pool before any
+                # later program can rewrite these pages) and the
+                # blocking transfer rides the tiering worker.
+                tier = self._tiering.demote(conv_id, kv.pages,
+                                            kv.tokens, kv.length,
+                                            kv.pending)
+                self._note_tier(conv_id,
+                                "host" if tier == "host" else "dropped")
+            elif not invalidate:
+                # Tiering off and the pin reclaimed: the prefix handle
+                # stays optimistic while the radix tree still covers
+                # the stream (turn N+1 adopts those blocks), but when
+                # nothing holds it anywhere the KV is gone for good —
+                # the handle must say so (prefill_estimate's
+                # non-cached contract, tests/test_kv_tiering.py).
+                covered = (self._prefix_cache.cached_blocks(kv.tokens)
+                           if self._prefix_cache is not None else 0)
+                if covered == 0:
+                    self._note_tier(conv_id, "dropped")
             self.allocator.free(kv.pages)
             streams.append(kv.tokens)
+        if invalidate and self._tiering is not None:
+            # Conversation deleted: no tier may keep serving its
+            # content (host buffers returned, store blob deleted).
+            self._tiering.forget(conv_id)
         if self._prefix_cache is not None and streams:
             if invalidate:
                 # Conversation-delete invalidation: prune EVERY stream
@@ -788,6 +899,30 @@ class InferenceEngine:
         with self._mu:
             return list(self._conv_cache)
 
+    # -- prefix-handle tier notes (docs/tiering.md) ---------------------------
+
+    def _note_tier(self, conv_id: str, tier: str) -> None:
+        """Queue a prefix-handle ``tier`` update. Deferred because the
+        callers hold ``self._mu`` and the state manager's lock sits
+        ABOVE the engine's in the ordering; engine-thread only, flushed
+        by :meth:`_flush_tier_notes` right after the lock drops."""
+        if self._state_manager is not None:
+            self._pending_tier_notes.append((conv_id, tier))
+
+    def _flush_tier_notes(self) -> None:
+        if not self._pending_tier_notes:
+            return
+        notes, self._pending_tier_notes = self._pending_tier_notes, []
+        sm = self._state_manager
+        if sm is None:
+            return
+        for cid, tier in notes:
+            try:
+                sm.update_prefix_handle_tier(cid, tier)
+            except Exception:  # noqa: BLE001 — bookkeeping, not a gate
+                log.exception("prefix-handle tier update failed for %s",
+                              cid)
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
@@ -820,6 +955,10 @@ class InferenceEngine:
         if comp is not None:
             comp.drain()
             comp.stop()
+        # Tiering worker after the loop: no more demotions/promotions
+        # can be dispatched; lazily re-created on engine restart.
+        if self._tiering is not None:
+            self._tiering.stop()
         # Executor-side worker teardown (the echo backend's simulated
         # device-queue thread); optional seam, lazily re-created if the
         # executor is driven again.
@@ -1277,6 +1416,7 @@ class InferenceEngine:
             cid = min(self._conv_cache,
                       key=lambda c: self._conv_cache[c].last_used)
             self._drop_conversation_locked(cid, invalidate=False)
+        self._flush_tier_notes()
         log.info("evicted conversation KV %s under pool pressure", cid,
                  extra={"fields": {"conversation_id": cid}})
         return True
@@ -1346,6 +1486,90 @@ class InferenceEngine:
                 continue
             return None
 
+    def _try_promote(self, seq: _Sequence, conv: str) -> str:
+        """Tiered-KV promotion at re-arrival (docs/tiering.md): pull
+        ``conv``'s demoted entry back into the device pool so the
+        ordinary adoption path below runs unchanged against a
+        rehydrated ``_ConvKV``. Returns:
+
+        - ``"none"`` — the plane holds nothing for this conversation;
+        - ``"wait"`` — an extract/store-load (or a transiently
+          contended pool) is still in flight: the sequence stays
+          pending and decode keeps running — promote latency hides
+          behind admission;
+        - ``"done"`` — promoted (host/store hit) OR degraded to the
+          recompute fallback: ``seq.carry`` then holds the exact
+          remembered token stream, so the re-prefill is token-for-token
+          what the cached KV held (no reliance on ``history_text``).
+        """
+        plane = self._tiering
+        status, entry = plane.claim(conv)
+        if status != "ready":
+            return status
+        t0 = time.perf_counter()
+        restorable = (entry.length > 0
+                      and (entry.payload is not None
+                           or (plane.content_free
+                               and entry.tier == "host")))
+        pages: Optional[List[int]] = None
+        if restorable:
+            need = PageAllocator.pages_for(entry.length,
+                                           self.spec.page_size)
+            pages = self._alloc_pages(need, seq)
+            if pages is None:
+                if self._inflight:
+                    # Transient: shedding is deferred while chunks are
+                    # in flight — put the entry back and retry at the
+                    # next reconcile instead of degrading to recompute.
+                    plane.restash(conv, entry)
+                    return "wait"
+                restorable = False
+        if restorable and entry.payload is not None:
+            leaves = plane.unpack(entry)
+            try:
+                self.executor.import_kv_pages(pages, leaves)
+            except Exception:  # noqa: BLE001 — degrade, never corrupt
+                log.exception("kv inject failed for %s; recomputing",
+                              conv)
+                self.allocator.free(pages)
+                pages = None
+                restorable = False
+        if restorable:
+            assert pages is not None
+            bt = np.zeros(self.spec.max_pages_per_seq, np.int32)
+            bt[:len(pages)] = pages
+            rec = _ConvKV(pages=list(pages), block_table=bt,
+                          length=entry.length,
+                          last_used=self._clock.now(),
+                          tokens=list(entry.tokens),
+                          pending=entry.pending)
+            with self._mu:
+                self._conv_cache[conv] = rec
+            self.allocator.pin(conv, pages)
+            plane.note_promoted(entry, entry.source_tier,
+                                (time.perf_counter() - t0) * 1e3)
+            plane.release(entry)
+            seq.served_tier = entry.source_tier
+            self._note_tier(conv, "hbm")
+            self._flush_tier_notes()
+            return "done"
+        # Recompute fallback: the remembered stream re-enters through
+        # ``carry`` (the continuation-prefill path), and the prompt is
+        # encoded WITHOUT the history_text fallback — the carry IS the
+        # history, exact to the token.
+        plane.release(entry)
+        seq.carry = list(entry.tokens) + (
+            [entry.pending] if entry.pending is not None else [])
+        if not seq.prompt_ids:
+            seq.prompt_ids = (self.tokenizer.encode(seq.req.prompt)
+                              or [self.tokenizer.bos_id])
+        plane.note_promoted(entry, "recompute",
+                            (time.perf_counter() - t0) * 1e3)
+        seq.served_tier = "recompute"
+        self._note_tier(conv, "dropped")
+        self._flush_tier_notes()
+        return "done"
+
     def _start_sequence(self, seq: _Sequence, slot: int) -> bool:
         """Admit ``seq`` into ``slot``. Returns False only when pages are
         unavailable (seq stays pending). May finish the sequence
@@ -1356,6 +1580,15 @@ class InferenceEngine:
             # Adopt the conversation's cached KV exactly once (single
             # ownership: the cache entry moves into this sequence).
             if conv and not seq.adopted:
+                promoted = False
+                if self._tiering is not None:
+                    with self._mu:
+                        resident = conv in self._conv_cache
+                    if not resident:
+                        status = self._try_promote(seq, conv)
+                        if status == "wait":
+                            return False
+                        promoted = status == "done"
                 with self._mu:
                     kv = self._conv_cache.pop(conv, None)
                     if kv is not None:
@@ -1366,6 +1599,11 @@ class InferenceEngine:
                     # The pin's page-second meter ends here; the pages
                     # continue on THIS sequence's meter below.
                     self._usage.unpin_kv(conv)
+                if kv is not None and self._tiering is not None \
+                        and not promoted:
+                    # Pin still resident — the hierarchy's top tier.
+                    self._tiering.note_hit("hbm")
+                    seq.served_tier = "hbm"
                 if kv is not None:
                     seq.cached_len = kv.length
                     seq.pos = kv.length
@@ -1849,7 +2087,12 @@ class InferenceEngine:
                     h = self._state_manager.prefix_handle(conversation_id)
                 except Exception:  # noqa: BLE001 — estimate, not a gate
                     h = None
-                if h:
+                if h and str(h.get("tier", "")) != "dropped":
+                    # "hbm"/"host"/"store"/unset: the prefix is either
+                    # still in the radix tree or promotable from a
+                    # lower tier — either way the prefill is mostly
+                    # skipped. "dropped" (pin reclaimed, no tiering)
+                    # means the KV is gone for good: all-new prefill.
                     ps = self.spec.page_size
                     cached = (int(h.get("length", 0)) // ps) * ps
         return cached, max(0, int(prompt_tokens))
@@ -2194,7 +2437,8 @@ class InferenceEngine:
             prompt_tokens=len(seq.prompt_ids),
             cached_tokens=seq.cached_len,
             finish_reason=reason,
-            error=error)
+            error=error,
+            kv_tier=seq.served_tier)
         seq.handle._finish(res)
 
     # -- usage attribution (observability/usage.py) ---------------------------
@@ -2783,7 +3027,8 @@ class InferenceEngine:
                     if self._prefix_cache is not None:
                         handle_rec = {"length": seq.pos,
                                       "pages": len(seq.pages),
-                                      "updated_at": self._clock.now()}
+                                      "updated_at": self._clock.now(),
+                                      "tier": "hbm"}
             seq.pages = []
         elif publish and seq.pages:
             self._prefix_cache.insert(seq.written_ids, list(seq.pages))
@@ -2891,7 +3136,8 @@ class InferenceEngine:
             prompt_tokens=len(seq.prompt_ids),
             cached_tokens=seq.cached_len,
             finish_reason=reason,
-            error=error)
+            error=error,
+            kv_tier=seq.served_tier)
         seq.handle._finish(res)
 
     def _expire_pins(self) -> None:
@@ -2906,6 +3152,7 @@ class InferenceEngine:
                 # tree keeps the prefix for turn N+1 (evicted there only
                 # by LRU/pressure), so no invalidate.
                 self._drop_conversation_locked(cid, invalidate=False)
+        self._flush_tier_notes()
 
     def _hbm_snapshot(self) -> Dict:
         """HBM accounting for the device-telemetry plane: pool
@@ -3001,6 +3248,10 @@ class InferenceEngine:
                 "prefill_token_budget":
                     int(self._mixed_cfg.prefill_token_budget),
             }
+        if self._tiering is not None:
+            # Tiered KV plane (docs/tiering.md): residency per tier,
+            # hit breakdown incl. recompute, spill/round-trip counts.
+            out["kv_tiering"] = self._tiering.stats()
         if self._prefix_cache is not None:
             pc = self._prefix_cache.get_stats()
             total = self.prefix_hits + self.prefix_misses

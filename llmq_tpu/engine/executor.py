@@ -219,6 +219,13 @@ class EchoExecutor:
     the full prompt, EOS. No device, no KV reads — but the engine still
     drives the full slot/page machinery against it."""
 
+    #: Tiered-KV contract (docs/tiering.md): this backend's "KV" has no
+    #: content — a sequence's state is fully determined by the token
+    #: stream the engine (re-)registers at prefill. The tiering plane
+    #: may therefore demote/promote conversations as METADATA-ONLY
+    #: entries (no payload extraction) with exact correctness.
+    kv_content_free = True
+
     def __init__(self, batch_size: int = 8, page_size: int = 16,
                  num_pages: int = 512, max_pages_per_seq: int = 32,
                  eos_id: int = 2, chunk_size: int = 1,
@@ -893,6 +900,9 @@ class JaxExecutor:
         #: their staging buffers can be rewritten.
         self._staging = HostStaging(ring=max(8, batch_size + 4))
         self._staging_fence_counts: Dict[str, int] = {}
+        #: Lazily-built donated scatter program for the tiered-KV
+        #: plane's promotions (import_kv_pages) — one compile total.
+        self._kv_inject = None
 
     def telemetry_info(self) -> Dict:
         """Model identity for the MFU estimator — shared with the
@@ -1548,6 +1558,68 @@ class JaxExecutor:
                 jnp.asarray(pf_temps),
                 self._next_key())
         return MixedChunkHandle(out, tok, pos, done, pf_first)
+
+    # -- tiered KV page transport (llmq_tpu/tiering/, docs/tiering.md) --------
+
+    #: Pages scattered per inject program call: ONE compiled program
+    #: serves every promotion (shorter groups pad with reserved page 0,
+    #: whose content is trash by convention — everything scatters
+    #: there), instead of one compile per conversation page count.
+    KV_INJECT_TILE = 8
+
+    def kv_page_spec(self) -> List[Tuple[Tuple[int, ...], np.dtype]]:
+        """Per-cache-leaf payload shape/dtype for ONE page, leaves in
+        ``jax.tree.leaves`` order (k, k_scale, v, v_scale for int8 KV —
+        the scale pools ride as ordinary leaves). The page axis (1) is
+        removed; the tiering plane's codec keys off this."""
+        leaves = self._jax.tree.leaves(self.cache)
+        return [((int(leaf.shape[0]),) + tuple(int(d)
+                                               for d in leaf.shape[2:]),
+                 np.dtype(leaf.dtype)) for leaf in leaves]
+
+    def export_kv_pages(self, pages: List[int]) -> List:
+        """DISPATCH the gather of ``pages``' payloads out of the device
+        pool — returns device arrays (one per cache leaf, shaped
+        ``(L, N, ...)``), no host sync: the caller's worker thread does
+        the blocking ``device_get``. Engine-thread only (reads the
+        live ``self.cache`` binding); safe against the donated pool
+        because the device stream is FIFO — the gather executes before
+        any later program can rewrite the pages."""
+        idx = self._jnp.asarray(pages, self._jnp.int32)
+        return [leaf[:, idx] for leaf in self._jax.tree.leaves(self.cache)]
+
+    def import_kv_pages(self, pages: List[int], leaves: List) -> None:
+        """Scatter host payloads back into the device pool at fresh
+        ``pages`` (promotion). Engine-thread only — this REBINDS
+        ``self.cache`` (donated jitted scatter, so the pool updates in
+        place; no transient second pool). The dispatch returns without
+        a host sync: a continuation prefill dispatched right after
+        reads the injected pages correctly because the device stream
+        is FIFO."""
+        jax, jnp = self._jax, self._jnp
+        if self._kv_inject is None:
+            kw = ({"out_shardings": self._kv_shardings}
+                  if self._kv_shardings is not None else {})
+            self._kv_inject = jax.jit(
+                lambda cache, idx, p: jax.tree.map(
+                    lambda c, q: c.at[:, idx].set(q), cache, p),
+                donate_argnums=(0,), **kw)
+        treedef = jax.tree.structure(self.cache)
+        T = self.KV_INJECT_TILE
+        n = len(pages)
+        for i0 in range(0, n, T):
+            ids = list(pages[i0:i0 + T])
+            grp = [np.asarray(lf[:, i0:i0 + T]) for lf in leaves]
+            pad = T - len(ids)
+            if pad:
+                ids.extend([0] * pad)    # reserved trash page
+                grp = [np.concatenate(
+                    [g, np.zeros(g.shape[:1] + (pad,) + g.shape[2:],
+                                 g.dtype)], axis=1) for g in grp]
+            payload = jax.tree.unflatten(
+                treedef, [jnp.asarray(g) for g in grp])
+            self.cache = self._kv_inject(
+                self.cache, jnp.asarray(ids, jnp.int32), payload)
 
     def gather_scalars(self, arrs: List) -> np.ndarray:
         """Fetch an admission wave's device scalars with overlapped

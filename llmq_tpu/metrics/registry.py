@@ -106,6 +106,10 @@ LABEL_CONTRACT = {
                          "escalate", "relax", "pause", "resume",
                          "skip"}),
     "path": frozenset({"mixed", "program"}),
+    # Tiered KV plane (llmq_tpu/tiering/, docs/tiering.md): where a
+    # conversation's KV lives / what served a re-arrival. Closed enum
+    # — "recompute" appears on hits only (nothing resides there).
+    "tier": frozenset({"hbm", "host", "store", "recompute"}),
     "point": None,      # compiled-in chaos fault points (fnmatch keys)
     "kind": frozenset({"error", "timeout", "partial", "oserror",
                        "latency", "crash"}),
@@ -178,6 +182,44 @@ class QueueMetrics:
             f"{ns}_prefix_cache_pages",
             "KV pages currently held by the radix prefix cache",
             ["engine"], registry=registry)
+        # Tiered KV plane (llmq_tpu/tiering/, docs/tiering.md):
+        # residency per tier, re-arrival hit breakdown (incl. the
+        # recompute fallback), and the demote/promote host-side
+        # latency histograms. Flushed at scrape (tiering.flush_metrics)
+        # — the demote/promote paths only buffer.
+        self.kv_tier_pages = Gauge(
+            f"{ns}_kv_tier_pages",
+            "KV pages resident per tier (hbm = pinned conversation "
+            "pages in the device pool; host/store = demoted entries)",
+            ["engine", "tier"], registry=registry)
+        self.kv_tier_bytes = Gauge(
+            f"{ns}_kv_tier_bytes",
+            "Serialized KV payload bytes resident per tier",
+            ["engine", "tier"], registry=registry)
+        self.kv_tier_hits = Counter(
+            f"{ns}_kv_tier_hits_total",
+            "Conversation re-arrivals by the tier that served their "
+            "cached prefix (recompute = re-prefilled from the "
+            "remembered token stream)", ["engine", "tier"],
+            registry=registry)
+        self.kv_tier_round_trips = Counter(
+            f"{ns}_kv_tier_round_trips_total",
+            "Demote→promote round-trips within the thrash window "
+            "(a hot conversation bouncing between HBM and the host "
+            "tier — the KVTierThrashing alert watches this)",
+            ["engine"], registry=registry)
+        self.kv_promote_ms = Histogram(
+            f"{ns}_kv_promote_ms",
+            "Host-side promotion work per re-arrival (page alloc + "
+            "payload unpack + inject dispatch; the device transfer "
+            "itself hides behind admission)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
+        self.kv_demote_ms = Histogram(
+            f"{ns}_kv_demote_ms",
+            "Host-side demotion work per reclaimed pin (gather "
+            "dispatch + entry registration; the device→host transfer "
+            "runs on the tiering worker)", ["engine"],
+            buckets=_STEP_MS_BUCKETS, registry=registry)
         # Mixed prefill+decode batching (docs/architecture.md "Mixed
         # step"): per-iteration occupancy of the fused program, plus
         # the decode-stall attribution histogram. ``path`` on the stall
@@ -532,6 +574,13 @@ def exposition() -> bytes:
         # above fed the goodput join.
         from llmq_tpu.observability.usage import get_usage_ledger
         get_usage_ledger().flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Tiered KV plane: per-tier residency gauges, hit counters and
+        # the buffered demote/promote histograms (docs/tiering.md).
+        from llmq_tpu.tiering import flush_metrics as tiering_flush
+        tiering_flush()
     except Exception:  # noqa: BLE001
         pass
     try:

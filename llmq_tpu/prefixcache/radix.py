@@ -49,7 +49,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from llmq_tpu.core.config import VALID_PREFIX_EVICTION as EVICTION_POLICIES
 from llmq_tpu.engine.kv_allocator import PageAllocator
@@ -111,6 +111,16 @@ class PrefixCache:
         self._pages = 0                  # nodes (== pages) in the tree
         self._seq = 0                    # insertion order for fifo
         self._mu = threading.RLock()
+        #: Demotion seam (llmq_tpu/tiering/, docs/tiering.md): when an
+        #: EVICTED leaf's page is about to leave HBM for good (the
+        #: tree holds the last reference), the callback observes
+        #: ``(token_path, page)`` BEFORE the free — the tiering plane
+        #: captures the payload there. None (the default) keeps the
+        #: exact pre-seam behavior: evict = free, nothing else.
+        #: Deliberately NOT fired from :meth:`invalidate` /
+        #: conversation delete — deleted content must not linger in a
+        #: lower tier.
+        self._on_demote: Optional[Callable[[List[int], int], None]] = None
         # Counters (read by engine metrics/stats):
         self.hits = 0
         self.misses = 0
@@ -152,6 +162,23 @@ class PrefixCache:
             self.hits += 1
             self.cached_tokens_served += m.length
         return m
+
+    def cached_blocks(self, ids: List[int]) -> int:
+        """Read-only probe: how many full page-aligned blocks of
+        ``ids`` the tree currently holds, WITHOUT retaining pages or
+        locking nodes (sizing heuristics — the tiering plane's
+        gone-for-good check — not admission)."""
+        ps = self.page_size
+        n = 0
+        with self._mu:
+            node = self._root
+            for b in range(len(ids) // ps):
+                child = node.children.get(tuple(ids[b * ps:(b + 1) * ps]))
+                if child is None:
+                    break
+                node = child
+                n += 1
+        return n
 
     def unlock(self, match: Optional[PrefixMatch]) -> None:
         """Drop the in-flight pins of a match (idempotent via the
@@ -207,6 +234,40 @@ class PrefixCache:
 
     # -- eviction ------------------------------------------------------------
 
+    def set_demotion_callback(
+            self, cb: Optional[Callable[[List[int], int], None]]) -> None:
+        """Install (or clear) the eviction→demotion seam. See the
+        ``_on_demote`` field doc; the callback runs under the cache
+        lock and must be cheap and never call back into the cache."""
+        with self._mu:
+            self._on_demote = cb
+
+    def _node_path(self, node: RadixNode) -> List[int]:
+        """The token-id path root→``node`` (the content identity of the
+        node's page — what a lower tier keys the payload on)."""
+        keys: List[Tuple[int, ...]] = []
+        cur: Optional[RadixNode] = node
+        while cur is not None and cur.key is not None:
+            keys.append(cur.key)
+            cur = cur.parent
+        out: List[int] = []
+        for k in reversed(keys):
+            out.extend(k)
+        return out
+
+    def _demote_hook(self, victim: RadixNode) -> None:
+        """Fire the demotion seam for an evicted leaf whose page the
+        tree holds the LAST reference of (a still-shared page isn't
+        leaving HBM — demoting it would duplicate resident content)."""
+        if self._on_demote is None:
+            return
+        if self.allocator.refcount(victim.page) != 1:
+            return
+        try:
+            self._on_demote(self._node_path(victim), victim.page)
+        except Exception:  # noqa: BLE001 — the seam must not break
+            log.exception("prefix-cache demotion callback failed")
+
     def _evictable(self) -> List[RadixNode]:
         out: List[RadixNode] = []
         stack = list(self._root.children.values())
@@ -253,6 +314,7 @@ class PrefixCache:
                 continue
             last_holder = self.allocator.refcount(victim.page) == 1
             assert victim.parent is not None
+            self._demote_hook(victim)
             del victim.parent.children[victim.key]
             self.allocator.free([victim.page])
             self._pages -= 1

@@ -1,0 +1,27 @@
+"""Tiered KV plane: HBM → host-DRAM → store (docs/tiering.md)."""
+
+from llmq_tpu.tiering.plane import (
+    TIERS,
+    HostTierPool,
+    KVTieringPlane,
+    TierEntry,
+    decode_blob,
+    encode_blob,
+    flush_metrics,
+    pack_pages,
+    page_payload_nbytes,
+    unpack_pages,
+)
+
+__all__ = [
+    "TIERS",
+    "HostTierPool",
+    "KVTieringPlane",
+    "TierEntry",
+    "decode_blob",
+    "encode_blob",
+    "flush_metrics",
+    "pack_pages",
+    "page_payload_nbytes",
+    "unpack_pages",
+]

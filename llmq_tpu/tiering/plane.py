@@ -1,0 +1,950 @@
+"""Tiered KV plane: HBM → host-DRAM → store hierarchy (ROADMAP item 4).
+
+Today a conversation's prefix/pinned KV pages live in HBM or die: the
+radix prefix cache evicts straight to the free list, and the pin TTL /
+pool pressure frees a between-turns conversation's pages outright —
+capping how many conversations a replica can keep warm at the KV pool
+size. This plane puts a memory hierarchy under that cliff:
+
+- **Demotion** (engine thread): when a conversation pin is reclaimed
+  (TTL / pool pressure — NOT delete), the engine hands the pin's pages
+  here before freeing them. The executor's page payloads are gathered
+  on-device (one dispatched slice per cache leaf, no host sync — the
+  device stream's FIFO order guarantees the gather reads the pool
+  before any later program can rewrite the freed pages) and the
+  blocking device→host transfer runs on the plane's worker thread, so
+  demotion never stalls the async decode pipeline (PR 10): transfers
+  ride a dedicated lane, exactly like chunk fetches.
+- **Host tier**: payloads land in preallocated page-granular host
+  buffers (:class:`HostTierPool` — the ``HostStaging`` churn-kill
+  discipline applied to a freelist instead of a ring: buffers are
+  allocated once at the configured capacity and recycled, never
+  per-demotion ``np.zeros``). Content-free backends (echo) hold
+  metadata-only entries — the token stream alone reconstructs their
+  state.
+- **Store tier**: past host capacity the coldest entries spill to the
+  conversation store's KV-payload seam (persistence.py ``save_kv`` —
+  serialized page payloads, int8 scale pools included as ordinary
+  cache leaves). A re-arrival loads the blob back through the worker
+  thread while the request waits in admission.
+- **Promotion**: triggered at conversation re-arrival —
+  ``InferenceEngine.submit`` calls :meth:`prepare` (store→host load
+  starts immediately, overlapping queue wait), and the cluster
+  router's affinity pass hints the same way (the router's
+  ``record_placement`` signal is literally "this conversation is
+  coming back here"). Admission then :meth:`claim`\\ s the entry:
+  pages are allocated, the payload is injected back into the device
+  pool (a dispatched program — the continuation prefill queues behind
+  it, so promote latency hides behind admission), and the engine's
+  ordinary conversation-KV adoption path runs unchanged.
+- **Recompute fallback**: an entry whose payload is gone (never
+  extracted, store load failed, promote timeout, pool too contended)
+  still remembers its exact token stream — the engine re-prefills it
+  verbatim, which is always correct, merely slower. Counted as the
+  ``recompute`` tier so the hierarchy's misses are visible.
+
+Eviction/spill ordering is LRU on observed re-arrival (prepare/claim
+touch entries), per "Observation, Not Prediction" (arXiv 2606.01839):
+the plane ranks conversations by when they actually came back, not by
+a predicted session length. The economics seam: every demotion ends
+the pin's HBM page-second meter (usage ledger — HBM residency is the
+priced resource), and every promotion that skips a prefill is credited
+as ``saved_prefill_device_seconds`` through the engine's existing
+prefix-hit accounting.
+
+Hard off-switch: ``executor.kv_tiering.enabled: false`` (the default)
+constructs no plane — every engine path is byte-identical to the
+HBM-only behavior, pinned by test.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("tiering")
+
+#: Closed tier enum — metric labels must stay within it
+#: (metrics/registry.py LABEL_CONTRACT "tier").
+TIERS = ("hbm", "host", "store", "recompute")
+
+#: A promotion this soon after the demotion counts as a thrash
+#: round-trip (the KVTierThrashing alert watches the rate).
+ROUND_TRIP_WINDOW_S = 60.0
+
+_BLOB_MAGIC = b"LLMQKV1\n"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, reaching into ml_dtypes for bfloat16-family
+    names numpy itself doesn't register."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class HostTierPool:
+    """Preallocated page-granular host buffers for demoted KV payloads.
+
+    One buffer holds one page's serialized payload (every cache leaf's
+    slice for that page id, concatenated). Buffers are allocated ONCE
+    up to ``capacity_bytes`` and recycled through a freelist — the
+    ``HostStaging`` discipline (engine/executor.py): the demotion path
+    must not page-fault fresh multi-megabyte arrays per conversation.
+    Unlike the staging ring there is no aliasing hazard to rotate
+    around — a buffer returns to the freelist only after its content
+    was consumed (unpacked for injection, or serialized to the store).
+    """
+
+    def __init__(self, capacity_bytes: int, page_nbytes: int) -> None:
+        self.page_nbytes = max(0, int(page_nbytes))
+        if self.page_nbytes > 0:
+            n = max(0, int(capacity_bytes) // self.page_nbytes)
+        else:
+            n = 0
+        # ONE arena allocation (virtual until touched); buffers are
+        # stable page-sized views into it — handing out a view never
+        # allocates, and give() resolves the view back to its index in
+        # O(1) via identity.
+        self._arena = np.empty(n * self.page_nbytes, np.uint8)
+        per = self.page_nbytes
+        self._bufs: List[np.ndarray] = [
+            self._arena[i * per:(i + 1) * per] for i in range(n)]
+        self._index: Dict[int, int] = {
+            id(b): i for i, b in enumerate(self._bufs)}
+        self._free: List[int] = list(range(n))
+        self._taken: set = set()
+        self._mu = threading.Lock()
+        self.total_buffers = n
+
+    def take(self, n: int) -> Optional[List[np.ndarray]]:
+        """``n`` buffers, or None if the pool can't satisfy all of them
+        (all-or-nothing, like the page allocator)."""
+        if n <= 0:
+            return []
+        with self._mu:
+            if len(self._free) < n:
+                return None
+            idx = [self._free.pop() for _ in range(n)]
+            self._taken.update(idx)
+        return [self._bufs[i] for i in idx]
+
+    def give(self, bufs: List[np.ndarray]) -> None:
+        """Return pool buffers to the freelist (non-pool arrays — the
+        transient store-load fallback — are ignored; double-gives are
+        no-ops)."""
+        if not bufs:
+            return
+        with self._mu:
+            for b in bufs:
+                i = self._index.get(id(b))
+                if i is not None and i in self._taken:
+                    self._taken.discard(i)
+                    self._free.append(i)
+
+    def free_buffers(self) -> int:
+        with self._mu:
+            return len(self._free)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.total_buffers * self.page_nbytes
+
+    def used_bytes(self) -> int:
+        return (self.total_buffers - self.free_buffers()) * self.page_nbytes
+
+
+# -- payload codec -------------------------------------------------------------
+
+
+def page_payload_nbytes(specs: List[Tuple[Tuple[int, ...], np.dtype]]) -> int:
+    """Serialized bytes for ONE page across every cache leaf."""
+    total = 0
+    for shape, dtype in specs:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * np.dtype(dtype).itemsize
+    return total
+
+
+def pack_pages(leaves: List[np.ndarray],
+               bufs: List[np.ndarray]) -> None:
+    """Serialize per-leaf page gathers (leaf i: ``(L, N, ...)`` with
+    the page axis at 1) into ``N`` flat per-page buffers: buffer j is
+    ``[leaf0[:, j] bytes][leaf1[:, j] bytes]...``.
+
+    ONE copy per (page, leaf), straight into the destination buffer
+    through a dtype view — no transient arrays/bytes on the worker
+    (this path exists to kill allocation churn; tobytes/frombuffer
+    would triple the payload bytes in throwaways)."""
+    n = len(bufs)
+    offs = 0
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.ndim <= 1:
+            continue
+        per = arr.nbytes // max(1, arr.shape[1])
+        shape = (arr.shape[0],) + arr.shape[2:]
+        for j in range(n):
+            dst = bufs[j][offs:offs + per].view(arr.dtype).reshape(shape)
+            np.copyto(dst, arr[:, j])
+        offs += per
+
+
+def unpack_pages(bufs: List[np.ndarray],
+                 specs: List[Tuple[Tuple[int, ...], np.dtype]]
+                 ) -> List[np.ndarray]:
+    """Inverse of :func:`pack_pages`: rebuild the per-leaf arrays
+    (``(L, N, ...)``, page axis 1) the executor's import scatters back
+    into the device pool. The per-page views are zero-copy; the
+    ``np.stack`` is the single necessary materialization (its output
+    is what ``jnp.asarray`` consumes)."""
+    n = len(bufs)
+    out: List[np.ndarray] = []
+    offs = 0
+    for shape, dtype in specs:
+        dt = np.dtype(dtype)
+        count = 1
+        for d in shape:
+            count *= int(d)
+        per = count * dt.itemsize
+        pages = [bufs[j][offs:offs + per].view(dt).reshape(shape)
+                 for j in range(n)]
+        out.append(np.stack(pages, axis=1))
+        offs += per
+    return out
+
+
+def encode_blob(bufs: List[np.ndarray],
+                specs: List[Tuple[Tuple[int, ...], np.dtype]]) -> bytes:
+    """Self-describing store blob: magic + JSON header (leaf specs +
+    page count) + the concatenated per-page payload bytes. Int8 scale
+    pools ride as ordinary leaves — the specs describe whatever the
+    executor's cache tree holds."""
+    header = json.dumps({
+        "specs": [[list(shape), np.dtype(dtype).name]
+                  for shape, dtype in specs],
+        "n_pages": len(bufs),
+    }).encode()
+    parts = [_BLOB_MAGIC, len(header).to_bytes(8, "big"), header]
+    parts.extend(bytes(b) for b in bufs)
+    return b"".join(parts)
+
+
+def decode_blob(blob: bytes) -> Tuple[
+        List[np.ndarray], List[Tuple[Tuple[int, ...], np.dtype]]]:
+    """Inverse of :func:`encode_blob` → (per-page flat arrays, specs).
+    Raises ValueError on a torn/foreign blob (the caller falls back to
+    recompute — a corrupt spill must never inject garbage KV)."""
+    if not blob.startswith(_BLOB_MAGIC):
+        raise ValueError("not a KV payload blob")
+    off = len(_BLOB_MAGIC)
+    hlen = int.from_bytes(blob[off:off + 8], "big")
+    off += 8
+    header = json.loads(blob[off:off + hlen])
+    off += hlen
+    specs = [(tuple(int(d) for d in shape), _np_dtype(name))
+             for shape, name in header["specs"]]
+    per = page_payload_nbytes(specs)
+    n = int(header["n_pages"])
+    if len(blob) - off != per * n:
+        raise ValueError("KV payload blob truncated")
+    bufs = [np.frombuffer(blob[off + j * per:off + (j + 1) * per],
+                          np.uint8).copy() for j in range(n)]
+    return bufs, specs
+
+
+# -- entries -------------------------------------------------------------------
+
+
+class TierEntry:
+    """One demoted conversation's KV: the exact token stream (always —
+    it is the recompute fallback), plus the page payload when the
+    backend has content to preserve."""
+
+    __slots__ = ("conv_id", "tokens", "length", "pending", "n_pages",
+                 "tier", "payload", "pooled", "ready", "demoted_at",
+                 "last_used", "wait_since", "loading", "source_tier",
+                 "abandoned", "spilling")
+
+    def __init__(self, conv_id: str, tokens: List[int], length: int,
+                 pending: Optional[int], n_pages: int,
+                 now: float) -> None:
+        self.conv_id = conv_id
+        self.tokens = tokens
+        self.length = length
+        self.pending = pending
+        self.n_pages = n_pages
+        #: Where the payload currently lives: "host" (buffers), "store"
+        #: (spilled blob), or "recompute" (tokens only).
+        self.tier = "recompute"
+        #: Per-page flat uint8 buffers (host-pool or transient).
+        self.payload: Optional[List[np.ndarray]] = None
+        #: Whether ``payload`` came from the HostTierPool (give back).
+        self.pooled = False
+        #: Set once the entry is claimable (extract/load finished, or
+        #: nothing to wait for).
+        self.ready = threading.Event()
+        self.demoted_at = now
+        self.last_used = now
+        #: perf_counter of the first claim that had to wait (drives the
+        #: promote-timeout → recompute fallback).
+        self.wait_since: Optional[float] = None
+        #: A store→host load is in flight.
+        self.loading = False
+        #: Tier the payload was SERVED from at claim time (a store
+        #: entry loaded back still counts as a store hit).
+        self.source_tier = "host"
+        #: Claimed-by-timeout while the worker still ran: the late
+        #: extract/load returns its buffers instead of publishing.
+        self.abandoned = False
+        #: Claimed by a spill job — counts as leaving the host tier
+        #: already, so the bound enforcement doesn't cascade-spill
+        #: everything while the first spill is in flight.
+        self.spilling = False
+
+
+# -- the plane -----------------------------------------------------------------
+
+
+class KVTieringPlane:
+    """The engine-attached tier manager. Thread model: ``demote`` /
+    ``claim`` run on the engine thread only (they touch the executor's
+    device pool bindings); ``prepare`` / ``forget`` / ``stats`` are
+    thread-safe; all blocking work (device→host transfers, store I/O,
+    spill serialization) runs on the plane's own worker thread."""
+
+    def __init__(self, cfg: Any, name: str, executor: Any, *,
+                 clock: Any = None,
+                 metrics: bool = True,
+                 on_ready: Optional[Callable[[], None]] = None) -> None:
+        self.cfg = cfg
+        self.name = name
+        self._executor = executor
+        self._clock = clock
+        self.metrics_enabled = bool(metrics)
+        self._on_ready = on_ready
+        self._export = getattr(executor, "export_kv_pages", None)
+        self._import = getattr(executor, "import_kv_pages", None)
+        self._content_free = bool(getattr(executor, "kv_content_free",
+                                          False))
+        spec_fn = getattr(executor, "kv_page_spec", None)
+        self._specs: Optional[List[Tuple[Tuple[int, ...], np.dtype]]] = (
+            spec_fn() if spec_fn is not None and self._export is not None
+            else None)
+        page_nbytes = (page_payload_nbytes(self._specs)
+                       if self._specs else 0)
+        self.pool = HostTierPool(
+            int(getattr(cfg, "host_capacity_mb", 256)) * (1 << 20),
+            page_nbytes)
+        self.host_max_conversations = int(
+            getattr(cfg, "host_max_conversations", 4096))
+        self.store_spill = bool(getattr(cfg, "store_spill", True))
+        self.promote_timeout_s = float(
+            getattr(cfg, "promote_timeout_s", 5.0))
+        #: Conversation store with the KV-payload seam (save_kv/
+        #: load_kv/delete_kv — persistence.py); feature-detected, so a
+        #: plain store simply disables the spill tier.
+        self.store: Any = None
+        self._entries: Dict[str, TierEntry] = {}
+        self._store_ids: set = set()   # conv ids with a spilled blob
+        self._mu = threading.Lock()
+        self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        #: HBM-pinned pages provider (the engine's allocator) for the
+        #: ``hbm`` row of kv_tier_pages — weakly owned by the caller
+        #: (returns None once the engine is gone).
+        self.hbm_provider: Optional[
+            Callable[[], Optional[Tuple[int, int]]]] = None
+        #: ``cb(conv_id, tier)`` fired when an entry's effective tier
+        #: changes ASYNCHRONOUSLY (worker-side spill/degradation) —
+        #: the engine forwards it to the prefix handle so
+        #: prefill_estimate never promises a prefix nothing can serve.
+        self.on_tier_change: Optional[Callable[[str, str], None]] = None
+        # Counters/buffers (flushed to prometheus at scrape time — the
+        # demote/promote paths themselves never touch a label child).
+        self.hits: Dict[str, int] = {t: 0 for t in TIERS}
+        self.demotions = 0
+        self.promotions = 0
+        self.spills = 0
+        self.round_trips = 0
+        self.store_errors = 0
+        self._demote_ms: List[float] = []
+        self._promote_ms: List[float] = []
+        self._flushed_hits: Dict[str, int] = {t: 0 for t in TIERS}
+        self._flushed_round_trips = 0
+        _register(self)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return float(self._clock.now())
+        return time.perf_counter()
+
+    def _submit(self, fn: Callable[[], None]) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._loop, name=f"kv-tiering-{self.name}",
+                daemon=True)
+            self._worker.start()
+        self._q.put(fn)
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one failed job must not
+                log.exception("kv-tiering job failed")  # kill the lane
+
+    def stop(self) -> None:
+        w, self._worker = self._worker, None
+        if w is not None:
+            self._q.put(None)
+            w.join(timeout=5.0)
+
+    # -- demotion (engine thread) ---------------------------------------------
+
+    def demote(self, conv_id: str, pages: List[int], tokens: List[int],
+               length: int, pending: Optional[int]) -> str:
+        """Capture a reclaimed pin's KV before the engine frees its
+        pages. Dispatches the on-device gather (no host sync) and hands
+        the blocking transfer to the worker; with no payload to
+        preserve (content-free backend, or no export seam) the entry is
+        metadata-only and immediately ready. Returns the entry's
+        optimistic tier ("host", or "recompute" when only the token
+        stream survives) — the caller's prefix-handle note; worker-side
+        degradations fire ``on_tier_change`` later. The token stream
+        alone is always a valid entry, and the caller frees the pages
+        afterwards regardless."""
+        t0 = time.perf_counter()
+        now = self._now()
+        entry = TierEntry(conv_id, list(tokens), int(length), pending,
+                          len(pages), now)
+        if self._export is not None and self._specs and pages:
+            try:
+                dev = self._export(list(pages))
+            except Exception:  # noqa: BLE001 — fall back to recompute
+                log.exception("kv export failed for %s", conv_id)
+                dev = None
+            if dev is not None:
+                entry.tier = "host"
+                self._submit(lambda: self._extract(entry, dev))
+            else:
+                entry.ready.set()
+        else:
+            # Metadata-only: correct for content-free backends (echo —
+            # the registered token stream IS the state); for anything
+            # else the entry serves as the recompute fallback.
+            entry.tier = "host" if self._content_free else "recompute"
+            entry.ready.set()
+        with self._mu:
+            old = self._entries.pop(conv_id, None)
+            self._entries[conv_id] = entry
+            self.demotions += 1
+            self._demote_ms.append((time.perf_counter() - t0) * 1e3)
+        if old is not None:
+            self._discard(old)
+        self._bound_host_locked_out()
+        return entry.tier
+
+    def _publish(self, entry: TierEntry, tier: str,
+                 payload: Optional[List[np.ndarray]],
+                 pooled: bool) -> None:
+        """Worker→claim handoff point: final state lands atomically
+        under the plane lock, THEN ready fires — a claim can never
+        observe a half-published entry. An entry the engine abandoned
+        (promote timeout) gets its buffers straight back instead."""
+        with self._mu:
+            if entry.abandoned:
+                abandoned = True
+            else:
+                abandoned = False
+                entry.tier = tier
+                entry.payload = payload
+                entry.pooled = pooled
+                entry.loading = False
+                entry.spilling = False
+                # A fresh readiness epoch: a LATER wait (re-spill,
+                # store load) must get the full promote timeout, not
+                # inherit this publication's elapsed one.
+                entry.wait_since = None
+            entry.ready.set()
+        if abandoned and payload is not None and pooled:
+            self.pool.give(payload)
+        if not abandoned and tier == "host":
+            # A demote burst can outrun the extracts: at demote time
+            # there may be no READY victim to spill, so the bound is
+            # re-enforced as each entry becomes resident.
+            self._bound_host_locked_out()
+        if not abandoned and tier == "recompute":
+            # The payload is gone for good (extract/spill/load failed)
+            # — downgrade the prefix handle so prefill_estimate stops
+            # promising a cached prefix nothing can serve.
+            self._tier_changed(entry.conv_id, "dropped")
+        elif not abandoned and tier == "store":
+            self._tier_changed(entry.conv_id, "store")
+        self._notify()
+
+    def _tier_changed(self, conv_id: str, tier: str) -> None:
+        """Fire the tier-change callback (the engine forwards it to
+        the state manager's prefix handle). Worker/any thread, called
+        with NO plane lock held — the callback takes the state
+        manager's lock and must not nest under ours."""
+        cb = self.on_tier_change
+        if cb is None:
+            return
+        try:
+            cb(conv_id, tier)
+        except Exception:  # noqa: BLE001 — bookkeeping, not a gate
+            log.exception("tier-change callback failed for %s", conv_id)
+
+    def _extract(self, entry: TierEntry, dev: List[Any]) -> None:
+        """Worker: blocking device→host transfer of the dispatched
+        gathers, then pack into host-pool buffers (spilling colder
+        entries if the pool is full; straight to the store past that)."""
+        try:
+            import jax
+
+            leaves = [np.asarray(a) for a in jax.device_get(dev)]
+        except Exception:  # noqa: BLE001 — jax-less plane tests inject
+            leaves = [np.asarray(a) for a in dev]   # numpy directly
+        if entry.abandoned:
+            entry.ready.set()
+            return
+        bufs = self._buffers_for(entry.n_pages)
+        assert self._specs is not None
+        if bufs is not None:
+            pack_pages(leaves, bufs)
+            self._publish(entry, "host", bufs, pooled=True)
+        elif self.store_spill and self._store_ok():
+            tmp = [np.empty(self.pool.page_nbytes, np.uint8)
+                   for _ in range(entry.n_pages)]
+            pack_pages(leaves, tmp)
+            if self._spill_blob(entry.conv_id, tmp):
+                self._publish(entry, "store", None, pooled=False)
+            else:
+                self._publish(entry, "recompute", None, pooled=False)
+        else:
+            self._publish(entry, "recompute", None, pooled=False)
+
+    def _buffers_for(self, n: int) -> Optional[List[np.ndarray]]:
+        """Worker: host-pool buffers for ``n`` pages, spilling the
+        coldest READY host entries to the store to make room."""
+        bufs = self.pool.take(n)
+        while bufs is None and self.store_spill and self._store_ok():
+            victim = self._coldest_host_entry()
+            if victim is None:
+                break
+            self._spill_entry(*victim)
+            bufs = self.pool.take(n)
+        return bufs
+
+    def _claim_for_spill_locked(
+            self, victim: TierEntry) -> Tuple[List[np.ndarray], bool]:
+        """Under self._mu: take EXCLUSIVE ownership of a spill victim's
+        payload. Popping the buffers into the job (instead of leaving
+        them on the entry) is load-bearing: a promote-timeout claim
+        that races the queued spill must find payload=None — otherwise
+        it could hand the buffers back to the pool while the spill is
+        still serializing from them (corrupt blob) or leak them
+        entirely (the job would find None and never give)."""
+        victim.ready.clear()
+        victim.spilling = True
+        bufs = victim.payload or []
+        victim.payload = None
+        pooled, victim.pooled = victim.pooled, False
+        return bufs, pooled
+
+    def _coldest_host_entry(
+            self) -> Optional[Tuple[TierEntry, List[np.ndarray], bool]]:
+        """Worker: claim the coldest spillable host entry — ready
+        drops (a concurrent promotion waits it out) and the payload
+        ownership transfers to the caller, all under the lock."""
+        with self._mu:
+            cands = [e for e in self._entries.values()
+                     if e.tier == "host" and e.pooled
+                     and e.ready.is_set() and e.payload
+                     and not e.abandoned and not e.spilling]
+            if not cands:
+                return None
+            victim = min(cands, key=lambda e: e.last_used)
+            bufs, pooled = self._claim_for_spill_locked(victim)
+            return victim, bufs, pooled
+
+    def _spill_entry(self, entry: TierEntry, bufs: List[np.ndarray],
+                     pooled: bool) -> None:
+        """Worker: move a claimed spill victim's payload (owned by
+        this job — see ``_claim_for_spill_locked``) to the store
+        tier, then return the buffers."""
+        if not bufs:
+            self._publish(entry, "recompute", None, pooled=False)
+            return
+        ok = self._spill_blob(entry.conv_id, bufs)
+        self._publish(entry, "store" if ok else "recompute", None,
+                      pooled=False)
+        if pooled:
+            self.pool.give(bufs)
+
+    def _spill_blob(self, conv_id: str, bufs: List[np.ndarray]) -> bool:
+        assert self._specs is not None
+        try:
+            self.store.save_kv(conv_id, encode_blob(bufs, self._specs))
+        except Exception:  # noqa: BLE001 — spill is best-effort
+            log.exception("kv spill failed for %s", conv_id)
+            with self._mu:
+                self.store_errors += 1
+            return False
+        with self._mu:
+            self.spills += 1
+            self._store_ids.add(conv_id)
+        return True
+
+    def _store_ok(self) -> bool:
+        return (self.store is not None
+                and hasattr(self.store, "save_kv"))
+
+    def _bound_host_locked_out(self) -> None:
+        """Entry-count bound (metadata-only backends have no byte
+        bound — but token streams are memory too): past
+        ``host_max_conversations`` the coldest ready entries spill to
+        the store (payload backends) or drop outright. Store-tier
+        entries don't count — their weight is the blob, not host
+        memory — so a big store keeps serving past the host bound."""
+        with self._mu:
+            resident = [e for e in self._entries.values()
+                        if e.tier != "store" and not e.spilling]
+            over = len(resident) - self.host_max_conversations
+            if over <= 0:
+                return
+            victims = sorted(
+                (e for e in resident
+                 if e.ready.is_set() and not e.abandoned),
+                key=lambda e: e.last_used)[:over]
+            dropped: List[TierEntry] = []
+            jobs: List[Tuple[TierEntry, List[np.ndarray], bool]] = []
+            for v in victims:
+                if (v.payload is not None and self.store_spill
+                        and self._store_ok()):
+                    jobs.append((v, *self._claim_for_spill_locked(v)))
+                else:
+                    del self._entries[v.conv_id]
+                    v.abandoned = True
+                    dropped.append(v)
+        for v in dropped:
+            self._discard(v)
+            self._tier_changed(v.conv_id, "dropped")
+        for job in jobs:
+            self._submit(lambda job=job: self._spill_entry(*job))
+
+    # -- promotion ------------------------------------------------------------
+
+    def _needs_load_locked(self, entry: TierEntry) -> bool:
+        """Under self._mu: a ready store-tier entry whose payload is
+        still only a blob — claiming it verbatim would degrade a store
+        hit to recompute; trigger the load instead."""
+        return (entry.ready.is_set() and entry.tier == "store"
+                and entry.payload is None and not entry.loading
+                and not entry.abandoned and self._store_ok())
+
+    def prepare(self, conv_id: str) -> bool:
+        """Re-arrival hint (any thread): start pulling a store-tier
+        entry's blob back toward the host NOW, so the load overlaps
+        queue wait / transport / admission instead of serializing with
+        it. Returns True when the plane holds (or is loading) an entry
+        for ``conv_id``."""
+        start_load = False
+        with self._mu:
+            entry = self._entries.get(conv_id)
+            if entry is None:
+                return False
+            entry.last_used = self._now()
+            if self._needs_load_locked(entry):
+                entry.loading = True
+                entry.ready.clear()
+                start_load = True
+        if start_load:
+            self._submit(lambda: self._load(entry))
+        return True
+
+    def _load(self, entry: TierEntry) -> None:
+        """Worker: store blob → host payload (published atomically)."""
+        blob = None
+        try:
+            blob = self.store.load_kv(entry.conv_id)
+        except Exception:  # noqa: BLE001
+            log.exception("kv store load failed for %s", entry.conv_id)
+            with self._mu:
+                self.store_errors += 1
+        if blob is not None and not entry.abandoned:
+            try:
+                bufs, _specs = decode_blob(blob)
+                bufs2 = self.pool.take(len(bufs))
+                if bufs2 is not None:
+                    for dst, src in zip(bufs2, bufs):
+                        dst[:len(src)] = src
+                    payload, pooled = bufs2, True
+                else:
+                    payload, pooled = bufs, False   # transient arrays
+                entry.source_tier = "store"
+                self._publish(entry, "store", payload, pooled=pooled)
+                return
+            except ValueError:
+                log.warning("corrupt KV blob for %s; recompute",
+                            entry.conv_id)
+        self._publish(entry, "recompute", None, pooled=False)
+
+    def claim(self, conv_id: str) -> Tuple[str, Optional[TierEntry]]:
+        """Admission-side takeover (engine thread). Returns
+        ``("none", None)`` when the plane holds nothing,
+        ``("wait", None)`` while an extract/load is still in flight
+        (the sequence stays pending — the engine keeps decoding), or
+        ``("ready", entry)`` with ownership of the entry transferred to
+        the caller: inject ``payload`` (when present) or recompute from
+        ``tokens``, then :meth:`release` the entry. A wait that
+        outlives ``promote_timeout_s`` degrades to a ready
+        payload-less entry — recompute beats stalling admission
+        forever."""
+        start_load = False
+        with self._mu:
+            entry = self._entries.get(conv_id)
+            if entry is None:
+                return "none", None
+            entry.last_used = self._now()
+            if self._needs_load_locked(entry):
+                # prepare() was never called (direct-driven engines):
+                # the claim itself triggers the store load.
+                entry.loading = True
+                entry.ready.clear()
+                start_load = True
+            elif entry.ready.is_set():
+                del self._entries[conv_id]
+                return "ready", entry
+            now = time.perf_counter()
+            if entry.wait_since is None:
+                entry.wait_since = now
+            elif now - entry.wait_since >= self.promote_timeout_s:
+                # Degrade to recompute. The payload is left in place
+                # for release() to return — NEVER handed back here: an
+                # in-flight spill owns its buffers exclusively (popped
+                # at claim-for-spill), so there is nothing to race,
+                # and an in-flight extract/load sees ``abandoned`` and
+                # returns its own buffers.
+                entry.abandoned = True
+                entry.tier = "recompute"
+                del self._entries[conv_id]
+                return "ready", entry
+        if start_load:
+            self._submit(lambda: self._load(entry))
+        # Bounded sub-ms wait outside the lock: keeps a synchronous
+        # run_until_idle driver from busy-spinning through its step
+        # budget while the worker finishes, without stalling decode
+        # (the engine only lands here when this conversation is the
+        # admission head anyway).
+        entry.ready.wait(0.0005)
+        with self._mu:
+            if (entry.ready.is_set()
+                    and self._entries.get(conv_id) is entry
+                    and not self._needs_load_locked(entry)):
+                del self._entries[conv_id]
+                return "ready", entry
+        return "wait", None
+
+    @property
+    def content_free(self) -> bool:
+        """The backend's KV has no content to preserve (echo): a
+        metadata-only entry restores with full correctness."""
+        return self._content_free
+
+    def restash(self, conv_id: str, entry: TierEntry) -> None:
+        """Put a claimed-but-unconsumed entry back (promotion deferred
+        — e.g. the pool was transiently contended with chunks in
+        flight). The entry stays ready; a newer entry for the same
+        conversation wins."""
+        with self._mu:
+            if conv_id not in self._entries:
+                # Fresh readiness epoch: the deferred promotion's next
+                # wait must not inherit this claim's elapsed timeout.
+                entry.wait_since = None
+                self._entries[conv_id] = entry
+                return
+        self._discard(entry)
+
+    def note_promoted(self, entry: TierEntry, tier: str,
+                      host_ms: float) -> None:
+        """Book a completed promotion: ``tier`` is what actually served
+        it (host/store/recompute); ``host_ms`` the admission-side work
+        (alloc + unpack + inject dispatch) — the part that could have
+        stalled admission."""
+        with self._mu:
+            self.promotions += 1
+            self.hits[tier] = self.hits.get(tier, 0) + 1
+            self._promote_ms.append(host_ms)
+            if (tier in ("host", "store")
+                    and self._now() - entry.demoted_at
+                    <= ROUND_TRIP_WINDOW_S):
+                self.round_trips += 1
+
+    def note_hit(self, tier: str) -> None:
+        """Count a re-arrival served WITHOUT the plane's involvement —
+        the ``hbm`` tier (pin still resident), or ``recompute`` when
+        the engine rebuilt without an entry."""
+        with self._mu:
+            self.hits[tier] = self.hits.get(tier, 0) + 1
+
+    def unpack(self, entry: TierEntry) -> Optional[List[np.ndarray]]:
+        """Per-leaf arrays for ``executor.import_kv_pages``; None when
+        the entry is metadata-only (content-free backend or recompute
+        fallback)."""
+        if entry.payload is None or self._specs is None:
+            return None
+        return unpack_pages(entry.payload, self._specs)
+
+    def release(self, entry: TierEntry) -> None:
+        """Return a claimed entry's pool buffers (call after the
+        payload was consumed or discarded)."""
+        self._discard(entry)
+
+    def _discard(self, entry: TierEntry) -> None:
+        entry.abandoned = True
+        bufs, entry.payload = entry.payload, None
+        if bufs is not None and entry.pooled:
+            self.pool.give(bufs)
+            entry.pooled = False
+
+    def forget(self, conv_id: str) -> None:
+        """Conversation deleted: drop every tier's copy (host buffers
+        back to the pool, store blob deleted on the worker)."""
+        with self._mu:
+            entry = self._entries.pop(conv_id, None)
+            spilled = conv_id in self._store_ids
+            self._store_ids.discard(conv_id)
+        if entry is not None:
+            self._discard(entry)
+        if spilled and self._store_ok():
+            self._submit(lambda: self._delete_blob(conv_id))
+
+    def _delete_blob(self, conv_id: str) -> None:
+        try:
+            self.store.delete_kv(conv_id)
+        except Exception:  # noqa: BLE001
+            log.exception("kv blob delete failed for %s", conv_id)
+            with self._mu:
+                self.store_errors += 1
+
+    def _notify(self) -> None:
+        cb = self._on_ready
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — wake-up is best-effort
+                pass
+
+    # -- visibility -----------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        with self._mu:
+            host = sum(1 for e in self._entries.values()
+                       if e.tier == "host")
+            store = sum(1 for e in self._entries.values()
+                        if e.tier == "store")
+            rec = sum(1 for e in self._entries.values()
+                      if e.tier == "recompute")
+        return {"host": host, "store": store, "recompute": rec}
+
+    def stats(self) -> Dict[str, Any]:
+        counts = self.counts()
+        with self._mu:
+            return {
+                "entries": len(self._entries),
+                "host_entries": counts["host"],
+                "store_entries": counts["store"],
+                "recompute_entries": counts["recompute"],
+                "host_bytes_used": self.pool.used_bytes(),
+                "host_bytes_total": self.pool.total_bytes,
+                "page_payload_bytes": self.pool.page_nbytes,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "spills": self.spills,
+                "round_trips": self.round_trips,
+                "store_errors": self.store_errors,
+                "hits": dict(self.hits),
+            }
+
+    def flush_metrics(self) -> None:
+        """Scrape-time flush (metrics/registry.exposition): gauges set,
+        counter deltas applied, buffered histogram observations
+        drained — the demote/promote paths never touch prometheus."""
+        if not self.metrics_enabled:
+            return
+        from llmq_tpu.metrics.registry import get_metrics
+
+        m = get_metrics()
+        with self._mu:
+            entries = list(self._entries.values())
+            demote_ms, self._demote_ms = self._demote_ms, []
+            promote_ms, self._promote_ms = self._promote_ms, []
+            hit_deltas = {t: self.hits.get(t, 0)
+                          - self._flushed_hits.get(t, 0) for t in TIERS}
+            self._flushed_hits = dict(self.hits)
+            rt_delta = self.round_trips - self._flushed_round_trips
+            self._flushed_round_trips = self.round_trips
+        host_pages = sum(e.n_pages for e in entries if e.tier == "host")
+        store_pages = sum(e.n_pages for e in entries
+                          if e.tier == "store")
+        per = self.pool.page_nbytes
+        m.kv_tier_pages.labels(self.name, "host").set(host_pages)
+        m.kv_tier_pages.labels(self.name, "store").set(store_pages)
+        m.kv_tier_bytes.labels(self.name, "host").set(host_pages * per)
+        m.kv_tier_bytes.labels(self.name, "store").set(store_pages * per)
+        hbm = self.hbm_provider() if self.hbm_provider is not None else None
+        if hbm is not None:
+            pages, nbytes = hbm
+            m.kv_tier_pages.labels(self.name, "hbm").set(pages)
+            m.kv_tier_bytes.labels(self.name, "hbm").set(nbytes)
+        for t in TIERS:
+            if hit_deltas.get(t):
+                m.kv_tier_hits.labels(self.name, t).inc(hit_deltas[t])
+        if rt_delta:
+            m.kv_tier_round_trips.labels(self.name).inc(rt_delta)
+        for v in demote_ms:
+            m.kv_demote_ms.labels(self.name).observe(v)
+        for v in promote_ms:
+            m.kv_promote_ms.labels(self.name).observe(v)
+
+
+# -- flush registry ------------------------------------------------------------
+
+_PLANES: "weakref.WeakSet[KVTieringPlane]" = weakref.WeakSet()
+_PLANES_LOCK = threading.Lock()
+
+
+def _register(plane: KVTieringPlane) -> None:
+    with _PLANES_LOCK:
+        _PLANES.add(plane)
+
+
+def flush_metrics() -> None:
+    """Scrape hook: flush every live plane's buffered telemetry."""
+    with _PLANES_LOCK:
+        planes = list(_PLANES)
+    for p in planes:
+        try:
+            p.flush_metrics()
+        except Exception:  # noqa: BLE001 — scrape must not fail here
+            log.exception("kv-tiering metric flush failed")
