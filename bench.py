@@ -43,7 +43,10 @@ LLMQ_BENCH_SLA_PAGE_8B / LLMQ_BENCH_SLA_KV_QUANT_8B (SLA-sweep
 serving geometry; the 8B path defaults to the tuned 128-token pages +
 int8 KV), LLMQ_BENCH_CACHE_DIR, LLMQ_BENCH_SKIP_TPU,
 LLMQ_BENCH_PREFIX_CACHE (=0 disables the radix prefix KV cache in the
-SLA sweeps for A/B comparison), LLMQ_BENCH_MIXED_BATCH (=0 disables
+SLA sweeps for A/B comparison), LLMQ_BENCH_RAGGED_ATTENTION (=1 routes
+the decode bench AND the SLA sweeps through the ragged paged-attention
+kernel — per-point kernel path + achieved HBM-bandwidth utilization
+are recorded for the A/B), LLMQ_BENCH_MIXED_BATCH (=0 disables
 token-budget mixed prefill+decode batching for A/B) /
 LLMQ_BENCH_MIXED_BUDGET / LLMQ_BENCH_MIXED_SLICES,
 LLMQ_BENCH_TENANCY_RATE / LLMQ_BENCH_TENANCY_SECS (victim offered rate
@@ -985,12 +988,17 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
     # chip (kernel: ops/pallas/fused_decode._fused_kernel_q8).
     kv_quant = os.environ.get("LLMQ_BENCH_KV_QUANT",
                               "int8" if quant == "int8" else "")
+    # Ragged paged-attention A/B (docs/performance.md "Ragged
+    # attention"): =1 routes the decode/mixed hot loop through the
+    # single ragged kernel, =0/unset keeps the bucket/fused baseline.
+    ragged_on = os.environ.get("LLMQ_BENCH_RAGGED_ATTENTION", "0") == "1"
     import jax.numpy as jnp
     ex = JaxExecutor(cfg, params, batch_size=batch, page_size=page_size,
                      num_pages=num_pages, chunk_size=chunk,
                      prefill_buckets=[128, 512], eos_id=-1,
                      cache_dtype=(jnp.int8 if kv_quant == "int8"
                                   else None),
+                     ragged_attention=ragged_on,
                      # Bench discipline: telemetry host-side only, no
                      # prometheus writes on the measured path.
                      telemetry_metrics=False)
@@ -1067,14 +1075,30 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
     # Shared implementation (observability/device.py): int8 doubles the
     # v5e MXU peak, same convention the live serving gauge uses.
     mfu = decode_mfu(tps, n_params, dev.device_kind, quant=quant)
+    # Achieved HBM-bandwidth utilization next to MFU: decode attention
+    # is BANDWIDTH-bound, so MFU alone under-tells the story. Explicit
+    # arithmetic over the measured tok/s and the model's byte
+    # constants; mean context = the prompt plus half the decoded span.
+    from llmq_tpu.models.llama import kv_bytes_per_token, weight_bytes
+    from llmq_tpu.observability.device import decode_hbm_bw_util
+    wb = (n_params if quant == "int8"
+          else weight_bytes(cfg))
+    kvb = kv_bytes_per_token(
+        cfg, cache_dtype=(jnp.int8 if kv_quant == "int8" else None))
+    mean_ctx = prompt_len + (n_tok / 2.0)
+    bw_util = decode_hbm_bw_util(tps, batch, wb, kvb, mean_ctx,
+                                 dev.device_kind)
+    kernel_path = "ragged" if ragged_on else "bucket"
     log(f"[tpu] decode: {step_ms:.2f} ms/token-step, {tps:,.0f} tok/s "
-        f"(B={batch}, chunk={chunk}), MFU={mfu*100:.2f}%  | "
+        f"(B={batch}, chunk={chunk}), MFU={mfu*100:.2f}%, "
+        f"HBM-BW~{bw_util*100:.1f}% [{kernel_path}]  | "
         f"prefill {prefill_tps:,.0f} tok/s serialized, "
         f"{prefill_pipe_tps:,.0f} tok/s pipelined")
     return {
         "model": cfg.name, "params_b": round(n_params / 1e9, 3),
         "quant": quant or "bf16",
         "kv_quant": kv_quant or "bf16",
+        "kernel_path": kernel_path,
         "device": dev.device_kind, "batch": batch, "context": max_seq,
         "page_size": page_size,
         "host_device_rtt_ms": round(rtt_ms, 1),
@@ -1084,6 +1108,7 @@ def bench_tpu_decode(model_name: str, batch: int, steps: int,
         "prefill_tokens_per_s": round(prefill_tps, 1),
         "prefill_pipelined_tokens_per_s": round(prefill_pipe_tps, 1),
         "mfu_pct": round(mfu * 100, 3),
+        "hbm_bw_util_pct": round(bw_util * 100, 2),
         "compile_s": round(compile_s, 1),
     }
 
@@ -1289,6 +1314,12 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                 "LLMQ_BENCH_MIXED_BUDGET", "128")),
             max_slices=int(os.environ.get(
                 "LLMQ_BENCH_MIXED_SLICES", "2")))
+    # Ragged paged-attention A/B (docs/performance.md "Ragged
+    # attention"): =1 serves the sweep through the ragged program
+    # (token-budget slice packing, no bucket programs), =0/unset keeps
+    # the bucket/fused baseline — per-point kernel path is recorded so
+    # the headline delta is attributable.
+    ragged_on = os.environ.get("LLMQ_BENCH_RAGGED_ATTENTION", "0") == "1"
     ex = JaxExecutor(cfg, params, batch_size=slots, page_size=page_size,
                      num_pages=num_pages, chunk_size=chunk,
                      prefill_buckets=[64],
@@ -1296,6 +1327,7 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                                   else None),
                      mixed_prefill_slices=(mb.max_slices if mb else 0),
                      mixed_slice_tokens=(mb.slice_tokens if mb else 0),
+                     ragged_attention=ragged_on,
                      eos_id=tok.eos_id,
                      # Matches the engine's enable_metrics=False below:
                      # telemetry stays host-side (read per rate point),
@@ -1474,7 +1506,31 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
             return round((cur.get("total_ms", 0.0)
                           - pre.get("total_ms", 0.0)) / n, 3)
 
+        # Kernel-path + bandwidth attribution: decode attention is
+        # bandwidth-bound, so the achieved HBM-BW utilization rides
+        # next to MFU (explicit arithmetic; mean context = the load
+        # mix's prompt plus half its decode span).
+        from llmq_tpu.models.llama import (kv_bytes_per_token,
+                                           weight_bytes)
+        from llmq_tpu.observability.device import decode_hbm_bw_util
+        _tps = dev.get("decode_tokens_per_s") or 0.0
+        _wb = (sum(int(x.size) for x in jax.tree.leaves(params))
+               if quant == "int8" else weight_bytes(cfg))
+        _kvb = kv_bytes_per_token(
+            cfg, cache_dtype=(jnp.int8 if kv_quant == "int8" else None))
+        # Mean live context MEASURED from this phase's completions
+        # (prompt + half the decoded span), not assumed from the load
+        # mix's constants — the attribution must track the workload.
+        _ctxs = [h.result.prompt_tokens + len(h.result.tokens) / 2.0
+                 for h in handles
+                 if h.done and h.result.finish_reason in ("eos", "length")]
+        _bw = decode_hbm_bw_util(
+            _tps, slots, _wb, _kvb,
+            mean_context=(sum(_ctxs) / len(_ctxs)) if _ctxs else 0.0,
+            device_kind=jax.devices()[0].device_kind)
         point["device"] = {
+            "kernel_path": "ragged" if ragged_on else "bucket",
+            "hbm_bw_util_pct": round(_bw * 100, 2),
             "decode_tokens_per_s": dev.get("decode_tokens_per_s"),
             "mfu_pct": dev.get("mfu_pct"),
             "host_device_rtt_ms": dev.get("host_device_rtt_ms"),
@@ -1712,9 +1768,19 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     out["decode_step_ms_est"] = round(ex.step_ms or 0.0, 3)
     out["warmup_s"] = round(warmup_s, 1)
     out["decode_steps"] = engine.steps
+    out["kernel_path"] = "ragged" if ragged_on else "bucket"
     out["sla_curve"] = curve
     out["realtime_p99_gate_ms"] = p99_gate_ms
     out["max_rate_realtime_p99_ok"] = max_ok_rate
+    if max_ok_rate == 0.0 and curve:
+        # Every probed rate failed the gate (the 8B sweep's ladder
+        # bottoms out at 0.5 req/s): 0.0 is NOT a measurement of zero
+        # capacity, it means the gate is unreachable at any probed
+        # rate — say so in the artifact instead of publishing a silent
+        # 0.0 (BENCH_r04/r05 carried exactly that).
+        out["gate_unreachable"] = True
+        out["gate_floor_probed"] = min(pt["offered_rate"]
+                                       for pt in curve)
     # RTT-tax milestone tracking (ROADMAP item 4: → ≈0): the headline
     # point already carries realtime_p99_minus_2rtt_ms (computed per
     # point and copied into ``out`` above); surface the pipeline
@@ -1866,6 +1932,12 @@ def main() -> None:
                 (tpu_tiers or {}).get("max_rate_realtime_p99_ok"),
             "max_rate_realtime_p99_ok_8b":
                 (tpu_tiers_8b or {}).get("max_rate_realtime_p99_ok"),
+            # 0.0 above is only meaningful with this flag false: True
+            # means the 8B gate failed at EVERY probed rate (down to
+            # the bisection floor) — unreachable, not zero capacity.
+            "gate_unreachable_8b":
+                (tpu_tiers_8b or {}).get("gate_unreachable", False),
+            "kernel_path": (tpu or {}).get("kernel_path"),
             "first_token_wire_realtime_p50_ms": (
                 ((tpu_tiers_8b or tpu_tiers or tiers or {})
                  .get("first_token_wire_ms") or {})

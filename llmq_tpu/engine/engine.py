@@ -2754,7 +2754,17 @@ class InferenceEngine:
         chunk = min(chunk, self._admission_cap())
         S = int(getattr(self.executor, "mixed_prefill_slices", 0))
         T = int(getattr(self.executor, "mixed_slice_tokens", 0))
+        # The dispatch can never out-pack the compiled program. Bucket
+        # mode packs ≤ S·T by construction (T = budget//S), so the
+        # clamp is a no-op there. In RAGGED mode T is the packed
+        # buffer's TOTAL capacity and slices have no fixed width — a
+        # single slice may take the whole budget (token-budget packing
+        # with no bucket boundaries), so the total clamps to T.
         budget = int(self._mixed_cfg.prefill_token_budget)
+        if getattr(self.executor, "ragged_attention", False):
+            budget = min(budget, T)
+        else:
+            budget = min(budget, S * T)
 
         # Decode rows: same eligibility/budgeting as _decode_once (no
         # join rows — mixed iterations reconcile every cycle, so there

@@ -80,6 +80,47 @@ def decode_mfu(tokens_per_s: float, n_params: int, device_kind: str,
     return tokens_per_s * 2.0 * n_params / peak_flops(device_kind, quant)
 
 
+#: device_kind substring → peak HBM bandwidth (bytes/s). Decode
+#: attention and the weight stream are BANDWIDTH-bound — MFU alone
+#: under-tells the story (a 2× MFU gain at the same bandwidth
+#: utilization just means fewer wasted bytes per useful FLOP), so the
+#: bench reports both side by side.
+PEAK_HBM_BYTES = {
+    "v5 lite": 819e9, "v5e": 819e9,
+    "v5p": 2765e9, "v4": 1228e9, "v6": 1640e9,
+}
+
+_DEFAULT_PEAK_HBM = 819e9
+
+
+def peak_hbm_bandwidth(device_kind: str) -> float:
+    """Peak HBM bytes/s for a device kind (v5e fallback, matching
+    :func:`peak_flops`)."""
+    kl = (device_kind or "").lower()
+    for k, v in PEAK_HBM_BYTES.items():
+        if k in kl:
+            return v
+    return _DEFAULT_PEAK_HBM
+
+
+def decode_hbm_bw_util(tokens_per_s: float, batch: int,
+                       weight_bytes: int, kv_bytes_per_token: int,
+                       mean_context: float, device_kind: str) -> float:
+    """Achieved HBM-bandwidth utilization of the decode loop as a
+    FRACTION: each decode STEP streams the weights once for the whole
+    batch plus each row's live KV window (≈ mean_context tokens), and
+    steps/s = tokens_per_s / batch. Explicit arithmetic over the model
+    constants — a lower bound (activations, page padding and the KV
+    writeback are excluded), reported next to MFU so bandwidth-bound
+    kernels are judged on the axis they are actually bound by."""
+    if tokens_per_s <= 0 or batch <= 0:
+        return 0.0
+    steps_per_s = tokens_per_s / batch
+    bytes_per_step = (weight_bytes
+                      + batch * kv_bytes_per_token * max(0.0, mean_context))
+    return steps_per_s * bytes_per_step / peak_hbm_bandwidth(device_kind)
+
+
 def measure_rtt(samples: int = 5) -> float:
     """Host↔device round-trip floor in ms (median of ``samples`` tiny
     synchronous dispatch+fetch cycles): every synchronous fetch pays

@@ -63,6 +63,19 @@ class TestSharedMath:
         rtt = measure_rtt(samples=3)
         assert 0 < rtt < 5000
 
+    def test_decode_hbm_bw_util_formula(self):
+        from llmq_tpu.observability.device import (decode_hbm_bw_util,
+                                                   peak_hbm_bandwidth)
+        assert peak_hbm_bandwidth("TPU v5e") == 819e9
+        assert peak_hbm_bandwidth("unknown") == 819e9
+        # 64 rows at 6400 tok/s = 100 steps/s; 2 GB weights + 64 rows
+        # × 100 KB/token × 512 tokens of live KV per step.
+        got = decode_hbm_bw_util(6400, 64, 2 * 10**9, 100_000, 512,
+                                 "v5e")
+        want = 100 * (2 * 10**9 + 64 * 100_000 * 512) / 819e9
+        assert got == pytest.approx(want)
+        assert decode_hbm_bw_util(0, 64, 1, 1, 1, "v5e") == 0.0
+
 
 # -- step decomposition through the serving path ------------------------------
 
@@ -193,6 +206,45 @@ class TestJaxTelemetry:
         assert comp2["cache_hits"] > 0
         srcs = {p["source"] for p in comp2["programs"].values()}
         assert "export_cache" in srcs
+
+    def test_ragged_warmup_compiles_strictly_fewer_programs(self):
+        """Ragged attention collapses the bucket grid: warmup with
+        ragged ON must report strictly fewer compile_seconds{program}
+        entries than the bucket-grid warmup of the same geometry, with
+        the ragged program present and no per-bucket entries."""
+        ex_b = _tiny_executor("dev-jax-bucket", mixed_prefill_slices=2,
+                              mixed_slice_tokens=8)
+        ex_b.warmup()
+        ex_r = _tiny_executor("dev-jax-ragged", mixed_prefill_slices=2,
+                              mixed_slice_tokens=8, ragged_attention=True,
+                              ragged_token_capacity=16)
+        ex_r.warmup()
+        progs_b = get_device_telemetry(
+            "dev-jax-bucket").snapshot()["compile"]["programs"]
+        progs_r = get_device_telemetry(
+            "dev-jax-ragged").snapshot()["compile"]["programs"]
+        assert len(progs_r) < len(progs_b), (progs_r, progs_b)
+        assert "ragged_chunk" in progs_r
+        assert not any(p.startswith("prefill") for p in progs_r)
+        assert any(p.startswith("prefill") for p in progs_b)
+
+    def test_stale_bucket_export_misses_ragged_key(self, tmp_path,
+                                                   monkeypatch):
+        """The export-cache key includes the ragged geometry: a disk
+        cache populated by the bucket grid must MISS for the ragged
+        executor (every ragged program re-lowered, zero hits)."""
+        monkeypatch.setenv("LLMQ_EXPORT_CACHE_DIR", str(tmp_path))
+        ex_b = _tiny_executor("dev-jax-exp-bucket")
+        ex_b.warmup()
+        assert ex_b._export_cache_key() != _tiny_executor(
+            "dev-jax-exp-key", ragged_attention=True)._export_cache_key()
+        ex_r = _tiny_executor("dev-jax-exp-ragged",
+                              ragged_attention=True)
+        ex_r.warmup()
+        comp = get_device_telemetry(
+            "dev-jax-exp-ragged").snapshot()["compile"]
+        assert comp["cache_hits"] == 0
+        assert not ex_r._from_export_cache
 
     def test_hbm_info_reports_resident_bytes(self):
         ex = _tiny_executor("dev-jax-hbm")
