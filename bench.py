@@ -54,7 +54,10 @@ and per-phase duration for the tenancy isolation section),
 LLMQ_BENCH_CONTROLPLANE_RATE / LLMQ_BENCH_CONTROLPLANE_SECS (base
 offered rate and per-phase duration for the control-plane ramp A/B),
 LLMQ_BENCH_KV_TIER_CONVS / LLMQ_BENCH_KV_TIER_SECS (conversation count
-and per-rate-point duration for the tiered-KV residency A/B).
+and per-rate-point duration for the tiered-KV residency A/B),
+LLMQ_BENCH_MESH (e.g. "dp2xtp4": serve the SLA sweeps through a dp×tp
+mesh — rule-table-sharded params, per-chip paged KV, MFU against
+N-chip peak FLOPs; per-point and headline mesh geometry recorded).
 """
 
 from __future__ import annotations
@@ -1233,6 +1236,27 @@ def _decomp(handles: List, tier: Optional[str] = None) -> Dict:
     return out
 
 
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """``"dp2xtp4"`` → ``{"dp": 2, "tp": 4}`` (axes joined by 'x');
+    bad specs fail loudly — a typo'd geometry must not silently bench
+    single-chip."""
+    import re as _re
+
+    out: Dict[str, int] = {}
+    for part in spec.lower().split("x"):
+        m = _re.fullmatch(r"(dp|tp)(\d+)", part.strip())
+        if m is None:
+            raise ValueError(
+                f"bad LLMQ_BENCH_MESH segment {part!r} "
+                f"(want e.g. dp2xtp4)")
+        if m.group(1) in out:
+            raise ValueError(
+                f"duplicate LLMQ_BENCH_MESH axis {m.group(1)!r} "
+                f"in {spec!r}")
+        out[m.group(1)] = int(m.group(2))
+    return out
+
+
 def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                       quant: str = "", min_realtime_n: int = 50,
                       chunk: int = 32, page_size: int = 16,
@@ -1320,9 +1344,31 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     # the bucket/fused baseline — per-point kernel path is recorded so
     # the headline delta is attributable.
     ragged_on = os.environ.get("LLMQ_BENCH_RAGGED_ATTENTION", "0") == "1"
+    # Mesh sweep (ISSUE 15, docs/multihost.md): LLMQ_BENCH_MESH (e.g.
+    # "dp2xtp4") serves the whole SLA sweep through a dp×tp mesh —
+    # params rule-table sharded, per-chip paged KV, MFU computed
+    # against N-chip peak FLOPs — and the headline records the mesh
+    # shape so curves across geometries never get compared blind.
+    mesh = None
+    mesh_shape = None
+    mesh_env = os.environ.get("LLMQ_BENCH_MESH", "")
+    if mesh_env:
+        mesh_shape = parse_mesh_spec(mesh_env)
+        from llmq_tpu.parallel import make_mesh
+        if ragged_on:
+            log("[poisson-tpu] ragged_attention is single-chip; "
+                "bucket path serves the mesh sweep")
+            ragged_on = False
+        dp = int(mesh_shape.get("dp", 1))
+        if dp > 1:
+            # dp splits the page axis and the batch rows: keep both
+            # divisible so the mesh path is real, not degraded.
+            num_pages += (-num_pages) % dp
+            slots += (-slots) % dp
+        mesh = make_mesh(dict(mesh_shape))
     ex = JaxExecutor(cfg, params, batch_size=slots, page_size=page_size,
                      num_pages=num_pages, chunk_size=chunk,
-                     prefill_buckets=[64],
+                     prefill_buckets=[64], mesh=mesh,
                      cache_dtype=(jnp.int8 if kv_quant == "int8"
                                   else None),
                      mixed_prefill_slices=(mb.max_slices if mb else 0),
@@ -1527,9 +1573,18 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         _bw = decode_hbm_bw_util(
             _tps, slots, _wb, _kvb,
             mean_context=(sum(_ctxs) / len(_ctxs)) if _ctxs else 0.0,
-            device_kind=jax.devices()[0].device_kind)
+            device_kind=jax.devices()[0].device_kind,
+            n_chips=(mesh.size if mesh is not None else 1),
+            # Weights replicate per dp group — each streams its copy.
+            dp=(int(mesh.shape.get("dp", 1)) if mesh is not None
+                else 1))
         point["device"] = {
             "kernel_path": "ragged" if ragged_on else "bucket",
+            # Per-rate-point mesh geometry: mfu_pct below is already
+            # computed against n_chips × peak (device telemetry), and
+            # "hbm" carries the truthful per-chip splits.
+            "mesh": mesh_shape,
+            "n_chips": (mesh.size if mesh is not None else 1),
             "hbm_bw_util_pct": round(_bw * 100, 2),
             "decode_tokens_per_s": dev.get("decode_tokens_per_s"),
             "mfu_pct": dev.get("mfu_pct"),
@@ -1769,6 +1824,11 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     out["warmup_s"] = round(warmup_s, 1)
     out["decode_steps"] = engine.steps
     out["kernel_path"] = "ragged" if ragged_on else "bucket"
+    # Headline mesh geometry (None = single chip): sla_curve numbers
+    # from different geometries are different machines — the artifact
+    # must say which one produced the headline.
+    out["mesh"] = mesh_shape
+    out["n_chips"] = mesh.size if mesh is not None else 1
     out["sla_curve"] = curve
     out["realtime_p99_gate_ms"] = p99_gate_ms
     out["max_rate_realtime_p99_ok"] = max_ok_rate
@@ -1938,6 +1998,10 @@ def main() -> None:
             "gate_unreachable_8b":
                 (tpu_tiers_8b or {}).get("gate_unreachable", False),
             "kernel_path": (tpu or {}).get("kernel_path"),
+            # The serving mesh behind the SLA numbers (None = one
+            # chip): dp×tp geometry + chip count, from LLMQ_BENCH_MESH.
+            "mesh": (tpu_tiers or {}).get("mesh"),
+            "mesh_n_chips": (tpu_tiers or {}).get("n_chips"),
             "first_token_wire_realtime_p50_ms": (
                 ((tpu_tiers_8b or tpu_tiers or tiers or {})
                  .get("first_token_wire_ms") or {})

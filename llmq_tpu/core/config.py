@@ -697,6 +697,14 @@ class KVTieringConfig:
     #: Seconds a promotion may wait on an in-flight extract/store load
     #: before admission falls back to recompute-from-tokens.
     promote_timeout_s: float = 5.0
+    #: Demotion economics (ROADMAP 4c): "saved_rate" ranks evictions at
+    #: every tier boundary (HBM pin reclaim, host→store spill) by the
+    #: usage ledger's per-conversation ``saved_prefill_device_seconds``
+    #: accrual rate — the measured recompute cost an eviction forfeits
+    #: — with LRU as the tiebreak (and the exact fallback when the
+    #: ledger is off or has no signal). "lru" restores pure
+    #: least-recently-used.
+    eviction_policy: str = "saved_rate"
 
     def __post_init__(self) -> None:
         if self.host_capacity_mb < 0:
@@ -706,6 +714,10 @@ class KVTieringConfig:
                 "kv_tiering.host_max_conversations must be >= 1")
         if self.promote_timeout_s <= 0:
             raise ValueError("kv_tiering.promote_timeout_s must be > 0")
+        if self.eviction_policy not in ("lru", "saved_rate"):
+            raise ValueError(
+                f"kv_tiering.eviction_policy must be 'lru' or "
+                f"'saved_rate' (got {self.eviction_policy!r})")
 
 
 @dataclass
@@ -778,6 +790,40 @@ class RaggedAttentionConfig:
 
 
 @dataclass
+class MeshConfig:
+    """Mesh-native serving executor (docs/multihost.md "Mesh-native
+    executor"). When enabled, the JAX executor builds a named
+    ``dp×tp`` device mesh and serves THROUGH it: params shard per the
+    regex partition-rule table (parallel/sharding.py), the paged KV
+    pool splits its KV-head axis over ``tp`` and its page axis over
+    ``dp`` (each dp replica owns its page universe, mirrored by the
+    host allocator), every compiled program lowers under the mesh with
+    explicit in/out shardings, and the warmup/export cache is keyed on
+    the mesh geometry so single-chip artifacts can never serve a mesh
+    (or vice versa). ``enabled: false`` (the DEFAULT) is a hard
+    off-switch: no mesh is built and the executor is byte-identical to
+    the single-chip path. The legacy ``tpu.mesh_shape`` knob still
+    builds a mesh when this block is off (back-compat alias)."""
+    enabled: bool = False
+    #: Named axis sizes, e.g. {"dp": 2, "tp": 4}. Must multiply to the
+    #: visible device count; one axis may be -1 (inferred).
+    shape: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for ax, n in (self.shape or {}).items():
+            if ax not in ("dp", "tp"):
+                raise ValueError(
+                    f"executor.mesh.shape axis must be 'dp' or 'tp' "
+                    f"(got {ax!r})")
+            if not isinstance(n, int) or (n < 1 and n != -1):
+                raise ValueError(
+                    f"executor.mesh.shape[{ax!r}] must be a positive "
+                    f"int or -1 (got {n!r})")
+        if self.enabled and not self.shape:
+            raise ValueError("executor.mesh.enabled requires a shape")
+
+
+@dataclass
 class ExecutorConfig:
     """Continuous-batching engine knobs (new scope)."""
     backend: str = "echo"               # echo | jax
@@ -803,6 +849,7 @@ class ExecutorConfig:
     async_pipeline: AsyncPipelineConfig = field(
         default_factory=AsyncPipelineConfig)
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
 
 
 @dataclass

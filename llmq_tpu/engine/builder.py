@@ -37,7 +37,16 @@ def build_engine(cfg: Config, *, name: str = "engine0",
     pipe_on = bool(getattr(pipe, "enabled", False))
     ragged = getattr(ex, "ragged_attention", None)
     ragged_on = bool(getattr(ragged, "enabled", False))
-    if ragged_on and getattr(cfg.tpu, "mesh_shape", None):
+    mesh_cfg = getattr(ex, "mesh", None)
+    # Mesh-native serving (docs/multihost.md): executor.mesh is the
+    # first-class knob (hard off-switch); the legacy tpu.mesh_shape
+    # still builds a mesh when the block is off (back-compat alias).
+    mesh_shape = None
+    if mesh_cfg is not None and getattr(mesh_cfg, "enabled", False):
+        mesh_shape = dict(mesh_cfg.shape)
+    elif getattr(cfg.tpu, "mesh_shape", None):
+        mesh_shape = dict(cfg.tpu.mesh_shape)
+    if ragged_on and mesh_shape:
         # The ragged kernel is a single-chip program; JaxExecutor would
         # silently disable it on the mesh path — disable it HERE so the
         # engine geometry and the boot log agree with what actually
@@ -142,12 +151,14 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             # on a host with enough RAM (checkpoint.py loads to host).
             params = quantize_params(params)
         mesh = None
-        if cfg.tpu.mesh_shape:
-            # Sharded serving (BASELINE config #5): the engine runs the
-            # model TP over the declared mesh; the quantization flag
-            # flows into param_shardings inside the executor.
+        if mesh_shape:
+            # Sharded serving (BASELINE config #5, docs/multihost.md
+            # "Mesh-native executor"): the engine runs the model dp×tp
+            # over the declared mesh; the quantization flag flows into
+            # param_shardings inside the executor, dp additionally
+            # splits the batch rows and the paged pool's page axis.
             from llmq_tpu.parallel import make_mesh
-            mesh = make_mesh(dict(cfg.tpu.mesh_shape))
+            mesh = make_mesh(mesh_shape)
         executor = JaxExecutor(
             mcfg, params,
             batch_size=ex.max_batch_size,
@@ -195,9 +206,11 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         kv_tiering=getattr(ex, "kv_tiering", None))
     tier = getattr(ex, "kv_tiering", None)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
-             "prefix_cache=%s mixed_batch=%s ragged_attention=%s "
+             "mesh=%s prefix_cache=%s mixed_batch=%s ragged_attention=%s "
              "async_pipeline=%s kv_tiering=%s)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
+             (mesh_shape if (ex.backend == "jax" and mesh_shape)
+              else "off"),
              "on" if getattr(ex.prefix_cache, "enabled", False) else "off",
              (f"on(budget={mixed.prefill_token_budget}"
               f"x{mixed_slices})" if mixed_on else "off"),

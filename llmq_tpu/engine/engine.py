@@ -522,8 +522,19 @@ class InferenceEngine:
         #: attribute read.
         self._tenancy = get_tenant_registry()
 
+        #: dp page universes (mesh-native executor, docs/multihost.md):
+        #: when the executor serves a dp×tp mesh, batch rows shard over
+        #: dp in contiguous blocks of B/dp and the pool's page axis
+        #: splits the same way — the allocator mirrors that split so a
+        #: sequence's pages are handed out of the universe its rows
+        #: compute on. 1 (every non-mesh executor) is byte-identical
+        #: to the unsharded allocator.
+        self.dp_shards = max(1, int(getattr(executor, "dp_shards", 1)))
+        self._rows_per_shard = max(
+            1, self.spec.batch_size // self.dp_shards)
         self.allocator = PageAllocator(self.spec.num_pages,
-                                       self.spec.page_size)
+                                       self.spec.page_size,
+                                       dp_shards=self.dp_shards)
         #: Radix-tree prefix KV cache (docs/prefix_cache.md). None when
         #: disabled — every code path below then degrades to the exact
         #: pre-cache behavior (the config's hard off-switch).
@@ -1231,11 +1242,30 @@ class InferenceEngine:
                              for (_, o, s) in self._pending]
             heapq.heapify(self._pending)
 
-    def _free_slot(self) -> Optional[int]:
+    def _slot_shard(self, slot: int) -> int:
+        """dp universe of a batch row: the batch dim shards over dp in
+        contiguous blocks (NamedSharding partitioning), so rows
+        [d·B/dp, (d+1)·B/dp) — and their pages — belong to replica d."""
+        if self.dp_shards <= 1:
+            return 0
+        return min(slot // self._rows_per_shard, self.dp_shards - 1)
+
+    def _free_slot(self, prefer_shard: Optional[int] = None
+                   ) -> Optional[int]:
+        """First free slot; with ``prefer_shard`` (a sequence adopting
+        KV pages that already live in one dp universe) a free slot in
+        that universe wins so the adoption stays replica-local —
+        falling back to any free slot (cross-universe reads are
+        correct, just not communication-free)."""
+        fallback = None
         for i, s in enumerate(self._slots):
             if s is None:
-                return i
-        return None
+                if (prefer_shard is None or self.dp_shards <= 1
+                        or self._slot_shard(i) == prefer_shard):
+                    return i
+                if fallback is None:
+                    fallback = i
+        return fallback
 
     def _least_urgent_active(
             self, exclude: Optional[_Sequence] = None, *,
@@ -1301,7 +1331,19 @@ class InferenceEngine:
                 heapq.heappop(self._pending)
                 deferred.append((prio, order, seq))
                 continue
-            slot = self._free_slot()
+            prefer = None
+            if self.dp_shards > 1:
+                # Keep adoptions replica-local: a sequence resuming onto
+                # pages it already holds, or adopting its conversation's
+                # pinned KV, prefers a row in those pages' dp universe.
+                if seq.pages:
+                    prefer = self.allocator.shard_of(seq.pages[0])
+                elif conv:
+                    with self._mu:
+                        kv = self._conv_cache.get(conv)
+                        if kv is not None and kv.pages:
+                            prefer = self.allocator.shard_of(kv.pages[0])
+            slot = self._free_slot(prefer)
             if (slot is None and self.preemption_enabled
                     and not self._inflight):
                 # No preemption while a chunk is in flight: the victim's
@@ -1413,8 +1455,20 @@ class InferenceEngine:
         with self._mu:
             if not self._conv_cache:
                 return False
-            cid = min(self._conv_cache,
-                      key=lambda c: self._conv_cache[c].last_used)
+            if (self._tiering is not None
+                    and self._tiering.eviction_policy == "saved_rate"):
+                # Demotion economics v2 (ROADMAP 4c): evict the pin
+                # with the lowest measured saved-prefill rate — a
+                # conversation whose KV keeps earning its HBM outlives
+                # a cold one; recency breaks ties (and carries the
+                # whole ranking when the ledger has no signal).
+                rate = self._usage.conversation_saved_rate
+                cid = min(self._conv_cache,
+                          key=lambda c: (rate(c),
+                                         self._conv_cache[c].last_used))
+            else:
+                cid = min(self._conv_cache,
+                          key=lambda c: self._conv_cache[c].last_used)
             self._drop_conversation_locked(cid, invalidate=False)
         self._flush_tier_notes()
         log.info("evicted conversation KV %s under pool pressure", cid,
@@ -1442,14 +1496,22 @@ class InferenceEngine:
                                    "victim_id": worst.req.id}})
         return True
 
-    def _alloc_pages(self, n: int,
-                     requester: _Sequence) -> Optional[List[int]]:
+    def _alloc_pages(self, n: int, requester: _Sequence,
+                     shard: Optional[int] = None) -> Optional[List[int]]:
         """Allocate with shedding, in increasing order of damage: idle
         pinned conversation KV (LRU) first, then pages parked with
         less-urgent *pending* sequences, then preempt-with-release of a
         strictly less-urgent runner. A victim is only ever less urgent
         than ``requester`` — a low-tier request can never strip a
-        realtime sequence's KV (priority inversion)."""
+        realtime sequence's KV (priority inversion).
+
+        ``shard`` pins the allocation to the requester's slot's dp page
+        universe (mesh path). A full universe falls back to any
+        universe with room BEFORE any shedding runs — bounded
+        non-locality is strictly cheaper than destroying cached KV or
+        preempting a runner while another replica's universe sits
+        idle (and it also avoids the admission deadlock where the
+        pinned universe is held entirely by more-urgent work)."""
         try:
             # Chaos seam: a simulated HBM allocation failure behaves
             # exactly like pool exhaustion — the requester stays
@@ -1459,11 +1521,20 @@ class InferenceEngine:
         except chaos.ChaosFault:
             return None
         while True:
-            pages = self.allocator.alloc(n)
+            pages = self.allocator.alloc(n, shard=shard)
             if pages is not None:
                 return pages
+            if shard is not None:
+                pages = self.allocator.alloc(n)
+                if pages is not None:
+                    return pages
+            # Shed deficit vs the FULLEST universe: every universe is
+            # now short (the fallback above failed), and an eviction
+            # only helps once SOME universe can hold all n pages
+            # (dp=1: exactly the old n - available()).
+            deficit = n - max(self.allocator.available_by_shard())
             if self._prefix_cache is not None and self._prefix_cache.evict_pages(
-                    n - self.allocator.available()) > 0:
+                    deficit) > 0:
                 # Cheapest shed first: zero-ref radix leaves cost no
                 # recompute for any RUNNING sequence (in-flight matches
                 # are lock-pinned and skipped; a future turn merely
@@ -1486,7 +1557,8 @@ class InferenceEngine:
                 continue
             return None
 
-    def _try_promote(self, seq: _Sequence, conv: str) -> str:
+    def _try_promote(self, seq: _Sequence, conv: str,
+                     shard: Optional[int] = None) -> str:
         """Tiered-KV promotion at re-arrival (docs/tiering.md): pull
         ``conv``'s demoted entry back into the device pool so the
         ordinary adoption path below runs unchanged against a
@@ -1515,7 +1587,7 @@ class InferenceEngine:
         if restorable:
             need = PageAllocator.pages_for(entry.length,
                                            self.spec.page_size)
-            pages = self._alloc_pages(need, seq)
+            pages = self._alloc_pages(need, seq, shard)
             if pages is None:
                 if self._inflight:
                     # Transient: shedding is deferred while chunks are
@@ -1585,7 +1657,8 @@ class InferenceEngine:
                     with self._mu:
                         resident = conv in self._conv_cache
                     if not resident:
-                        status = self._try_promote(seq, conv)
+                        status = self._try_promote(
+                            seq, conv, self._slot_shard(slot))
                         if status == "wait":
                             return False
                         promoted = status == "done"
@@ -1705,7 +1778,8 @@ class InferenceEngine:
                              f"{self.allocator.total}")
                 return True
             if need > 0:
-                pages = self._alloc_pages(need, seq)
+                pages = self._alloc_pages(need, seq,
+                                          self._slot_shard(slot))
                 if pages is None:
                     if match_seed is not None:
                         # Give the matched pages back (a retried
@@ -2006,7 +2080,9 @@ class InferenceEngine:
             seq.pos + budget, self.spec.page_size) - len(seq.pages)
         if need <= 0:
             return True
-        pages = self._alloc_pages(need, seq)
+        pages = self._alloc_pages(
+            need, seq,
+            None if seq.slot is None else self._slot_shard(seq.slot))
         if pages is None:
             return False
         seq.block_table[len(seq.pages):len(seq.pages) + need] = pages
@@ -2227,8 +2303,16 @@ class InferenceEngine:
             join_plan.append((seq, slot, b, max(0, need)))
         if not plan and not join_plan:
             return None
-        if (sum(n for *_, n in plan) + sum(n for *_, n in join_plan)
-                > self.allocator.available()):
+        # Speculative growth must not shed: every universe the plan
+        # draws from needs headroom up front (a GLOBAL sum would pass
+        # while one dp universe is exhausted, breaking the no-shedding
+        # assert below).
+        need_by_shard: Dict[int, int] = {}
+        for seq, slot, _, n in plan + join_plan:
+            need_by_shard[self._slot_shard(slot)] = (
+                need_by_shard.get(self._slot_shard(slot), 0) + n)
+        if any(n > self.allocator.available(shard=d)
+               for d, n in need_by_shard.items()):
             return None     # would require shedding → reconcile
         t_asm = time.perf_counter()   # step decomposition: dispatch leg
         budgets = np.zeros(B, np.int32)   # read again at process time
@@ -2237,7 +2321,8 @@ class InferenceEngine:
         temps = self._staging.take("chunk.temp", (B,), np.float32)
         for seq, slot, b, need in plan + join_plan:
             if need > 0:
-                pages = self.allocator.alloc(need)
+                pages = self.allocator.alloc(
+                    need, shard=self._slot_shard(slot))
                 assert pages is not None    # checked above
                 seq.block_table[len(seq.pages):len(seq.pages) + need] = pages
                 seq.pages.extend(pages)
@@ -3182,6 +3267,10 @@ class InferenceEngine:
                                    if self._prefix_cache is not None
                                    else 0),
         }
+        if alloc.dp_shards > 1:
+            # Mesh path: free pages per dp universe — a replica can be
+            # page-starved while the GLOBAL count looks healthy.
+            out["kv_pages_free_by_dp_shard"] = alloc.available_by_shard()
         info_fn = getattr(self.executor, "hbm_info", None)
         if info_fn is not None:
             try:

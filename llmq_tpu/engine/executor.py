@@ -602,6 +602,12 @@ class JaxExecutor:
         # kernels are safe here (the flag keeps them away from the
         # differentiated training path, which shares forward_prefill).
         model_cfg = _dc.replace(model_cfg, pallas_batched_prefill=True)
+        #: dp universes of the paged pool (docs/multihost.md): > 1 when
+        #: the mesh has a dp axis that divides BOTH the batch and the
+        #: page count — the batch dim then shards over dp, the pool's
+        #: page axis splits into per-replica page universes, and the
+        #: host allocator (engine/kv_allocator.py) mirrors the split.
+        self.dp_shards = 1
         if mesh is not None and mesh.size > 1:
             import dataclasses
 
@@ -611,13 +617,27 @@ class JaxExecutor:
 
             model_cfg = dataclasses.replace(model_cfg, pallas=False)
             quantized = is_quantized(params["layers"]["wq"])
+            # Regex partition-rule table → NamedSharding pytree →
+            # device_put placement (SNIPPETS [2]/[3] pjit shape): tp
+            # shards heads/MLP/vocab, dp replicates the weights.
             params = shard_params(
                 params, param_shardings(model_cfg, mesh,
-                                        quantized=quantized))
+                                        quantized=quantized,
+                                        params=params))
+            dp = int(mesh.shape.get("dp", 1))
+            if dp > 1:
+                if num_pages % dp == 0 and batch_size % dp == 0:
+                    self.dp_shards = dp
+                else:
+                    log.warning(
+                        "mesh dp=%d does not divide num_pages=%d / "
+                        "batch_size=%d; dp degrades to replication",
+                        dp, num_pages, batch_size)
             self._kv_shardings = kv_cache_shardings(
                 model_cfg, mesh,
                 quantized=(jnp.dtype(cache_dtype or model_cfg.dtype)
-                           == jnp.int8))
+                           == jnp.int8),
+                num_pages=(num_pages if self.dp_shards > 1 else 0))
         else:
             self._kv_shardings = None
         self.model_cfg = model_cfg
@@ -702,19 +722,35 @@ class JaxExecutor:
         if self._kv_shardings is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             _repl = NamedSharding(mesh, PartitionSpec())
+            # Batch-dim arrays (tokens/positions/block tables/carries)
+            # shard over dp when the pool does: contiguous row blocks
+            # of B/dp land with their dp replica's page universe. A
+            # tp-only mesh keeps them replicated — today's layout.
+            _batch = (NamedSharding(mesh, PartitionSpec("dp"))
+                      if self.dp_shards > 1 else _repl)
+            self._batch_shd = _batch if self.dp_shards > 1 else None
             kvs = dict(self._kv_shardings)
             jit_step = partial(jax.jit, donate_argnums=(1,),
                                out_shardings=(_repl, kvs))
-            # decode_chunk returns (out, tok, pos, done, cache).
+            # decode returns ((B,) toks, cache) — batch-sharded.
+            jit_decode = partial(jax.jit, donate_argnums=(1,),
+                                 out_shardings=(_batch, kvs))
+            # decode_chunk returns (out, tok, pos, done, cache); the
+            # tail three are the dp-sharded device-resident carry the
+            # pipelined next chunk consumes without ever leaving the
+            # mesh (sharded-array futures).
             jit_chunk = partial(jax.jit, donate_argnums=(1,),
-                                out_shardings=(_repl, _repl, _repl,
-                                               _repl, kvs))
-            # mixed_chunk returns (out, tok, pos, done, pf_first, cache).
+                                out_shardings=(_batch, _batch, _batch,
+                                               _batch, kvs))
+            # mixed_chunk returns (out, tok, pos, done, pf_first, cache);
+            # pf_first is slice-indexed (not batch) → replicated.
             jit_mixed = partial(jax.jit, donate_argnums=(1,),
-                                out_shardings=(_repl, _repl, _repl,
-                                               _repl, _repl, kvs))
+                                out_shardings=(_batch, _batch, _batch,
+                                               _batch, _repl, kvs))
         else:
+            self._batch_shd = None
             jit_step = partial(jax.jit, donate_argnums=(1,))
+            jit_decode = jit_step
             jit_chunk = jit_step
             jit_mixed = jit_step
 
@@ -743,7 +779,7 @@ class JaxExecutor:
                                 top_k=top_k, top_p=top_p)
             return toks, cache
 
-        @jit_step
+        @jit_decode
         def _decode_step(params, cache, tokens, positions, block_tables,
                          temperatures, key):
             logits, cache = forward_decode(
@@ -1024,7 +1060,11 @@ class JaxExecutor:
             n_params = 0
         return {"n_params": n_params,
                 "device_kind": jax.devices()[0].device_kind,
-                "quant": quant}
+                "quant": quant,
+                # MFU denominator scales with the mesh: N chips serve
+                # N× the peak FLOPs (bench + live gauge agree).
+                "n_chips": (self.mesh.size
+                            if self.mesh is not None else 1)}
 
     def hbm_info(self) -> List[Dict]:
         """Per-chip HBM accounting: weights / KV-pool bytes resident on
@@ -1124,12 +1164,38 @@ class JaxExecutor:
         self._key, sub = self._jax.random.split(self._key)
         return sub
 
+    def _batch_arr(self, x, dtype):
+        """Place one batch-dim operand. Off the dp path this is exactly
+        ``jnp.asarray`` (byte-identical single-chip/tp behavior); on a
+        dp mesh the host staging buffer is explicitly ``device_put``
+        with the dp batch sharding — each replica receives its
+        contiguous B/dp rows, assembled straight from the staging
+        buffer (no full-batch replica on any one chip)."""
+        if self._batch_shd is None:
+            return self._jnp.asarray(x, dtype)
+        if isinstance(x, self._jax.Array):
+            # Device-resident carry: already dp-sharded by the previous
+            # program's out_shardings; device_put is then a no-op.
+            return self._jax.device_put(x, self._batch_shd)
+        return self._jax.device_put(np.asarray(x, dtype),
+                                    self._batch_shd)
+
+    def _zeros_done(self):
+        """Fresh all-false done latch, placed like every other batch
+        operand (dp-sharded on the dp path, plain otherwise)."""
+        return self._batch_arr(
+            np.zeros(self.spec.batch_size, np.bool_), np.bool_)
+
     def _export_cache_dir(self) -> Optional[str]:
         """Directory for serialized post-lowering program artifacts
         (``jax.export``). LLMQ_EXPORT_CACHE_DIR overrides; otherwise an
         ``export/`` subdir of the persistent XLA compilation cache when
-        one is configured. Disabled (None) on the mesh path — exported
-        multi-device calling conventions are not worth the risk here.
+        one is configured. Mesh programs export too (the sharded
+        StableHLO carries the partition annotations) — the cache KEY
+        carries the full mesh geometry (``_export_cache_key``), so a
+        single-chip artifact can never be deserialized into a mesh
+        serving process, nor a stale-geometry artifact into a reshaped
+        mesh (pinned by tests/test_scale.py).
 
         Why this exists on top of the XLA cache: XLA *compilation* is
         fully cached across restarts, but Python tracing + Mosaic
@@ -1141,8 +1207,6 @@ class JaxExecutor:
         instead of re-lowering."""
         import os
 
-        if self.mesh is not None and self.mesh.size > 1:
-            return None
         d = os.environ.get("LLMQ_EXPORT_CACHE_DIR")
         if d:
             return d
@@ -1183,10 +1247,21 @@ class JaxExecutor:
             except OSError:
                 pass
         cfg = self.model_cfg
+        # Mesh identity: (axis names, axis sizes, dp page universes).
+        # A single-chip artifact must MISS when the same model builds
+        # on a mesh, a dp2×tp4 artifact must MISS on tp8 (geometry
+        # change), and vice versa — a lowered program's collectives
+        # and sharding annotations are part of its identity.
+        mesh_ident = (None if self.mesh is None else
+                      (tuple(self.mesh.axis_names),
+                       tuple(int(self.mesh.shape[a])
+                             for a in self.mesh.axis_names),
+                       self.dp_shards))
         ident = repr((jax.__version__, jax.devices()[0].device_kind,
                       cfg, self.spec, self.chunk_size, self.prefill_batch,
                       tuple(self.prefill_buckets), self._top_k,
                       self._top_p,
+                      ("mesh", mesh_ident),
                       # Mixed-batch geometry: (S, T) changes the mixed
                       # program's shapes — artifacts must not collide
                       # across budget/slice reconfigurations.
@@ -1238,6 +1313,15 @@ class JaxExecutor:
         def sds(shape, dtype):
             return jax.ShapeDtypeStruct(shape, dtype)
 
+        def bsds(shape, dtype):
+            """Batch-dim aval: carries the dp sharding on the dp path
+            so the AOT signature matches the device_put'd dispatch
+            arrays exactly; plain aval otherwise (today's)."""
+            if self._batch_shd is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=self._batch_shd)
+
         # Params/cache keep their shardings (mesh path: the AOT program
         # must be partitioned exactly like the runtime arrays).
         abstract = lambda tree: jax.tree.map(  # noqa: E731
@@ -1270,13 +1354,13 @@ class JaxExecutor:
                                   sds((NPF, MP), i32), sds((NPF,), f32),
                                   key)))
         jobs.append(("decode", self._decode_step,
-                     (p, c, sds((B,), i32), sds((B,), i32),
-                      sds((B, MP), i32), sds((B,), f32), key)))
+                     (p, c, bsds((B,), i32), bsds((B,), i32),
+                      bsds((B, MP), i32), bsds((B,), f32), key)))
         if self.chunk_size > 1:
             jobs.append(("decode_chunk", self._decode_chunk,
-                         (p, c, sds((B,), i32), sds((B,), i32),
-                          sds((B, MP), i32), sds((B,), f32),
-                          sds((B,), i32), sds((B,), jnp.bool_), key)))
+                         (p, c, bsds((B,), i32), bsds((B,), i32),
+                          bsds((B, MP), i32), bsds((B,), f32),
+                          bsds((B,), i32), bsds((B,), jnp.bool_), key)))
         if self._mixed_chunk is not None and self.ragged_attention:
             S = self.mixed_prefill_slices
             N = self._ragged_buf
@@ -1290,9 +1374,9 @@ class JaxExecutor:
         elif self._mixed_chunk is not None:
             S, T = self.mixed_prefill_slices, self.mixed_slice_tokens
             jobs.append(("mixed_chunk", self._mixed_chunk,
-                         (p, c, sds((B,), i32), sds((B,), i32),
-                          sds((B, MP), i32), sds((B,), f32),
-                          sds((B,), i32), sds((B,), jnp.bool_),
+                         (p, c, bsds((B,), i32), bsds((B,), i32),
+                          bsds((B, MP), i32), bsds((B,), f32),
+                          bsds((B,), i32), bsds((B,), jnp.bool_),
                           sds((S, T), i32), sds((S, T), i32),
                           sds((S,), i32), sds((S, MP), i32),
                           sds((S,), f32), key)))
@@ -1595,10 +1679,10 @@ class JaxExecutor:
         fn = self._aot.get("decode", self._decode_step)
         toks, self.cache = fn(
             self.params, self.cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32),
-            jnp.asarray(temperatures, jnp.float32),
+            self._batch_arr(tokens, jnp.int32),
+            self._batch_arr(positions, jnp.int32),
+            self._batch_arr(block_tables, jnp.int32),
+            self._batch_arr(temperatures, jnp.float32),
             self._next_key())
         return np.asarray(toks)
 
@@ -1631,10 +1715,12 @@ class JaxExecutor:
         if carry is not None:
             tok_in, pos_in, done_in = carry.tok, carry.pos, carry.done
         else:
-            tok_in = jnp.asarray(tokens, jnp.int32)
-            pos_in = jnp.asarray(positions, jnp.int32)
-            done_in = jnp.zeros(self.spec.batch_size, bool)
+            tok_in = self._batch_arr(tokens, jnp.int32)
+            pos_in = self._batch_arr(positions, jnp.int32)
+            done_in = self._zeros_done()
         for slot, tok_dev, pos in (overrides or ()):
+            # Eager scatters preserve the carry's dp sharding (pinned
+            # by test), so the AOT program's input signature holds.
             tok_in = tok_in.at[slot].set(tok_dev.astype(jnp.int32))
             pos_in = pos_in.at[slot].set(jnp.int32(pos))
             done_in = done_in.at[slot].set(False)
@@ -1642,9 +1728,9 @@ class JaxExecutor:
             out, tok, pos, done, self.cache = fn(
                 self.params, self.cache,
                 tok_in, pos_in,
-                jnp.asarray(block_tables, jnp.int32),
-                jnp.asarray(temperatures, jnp.float32),
-                jnp.asarray(budgets, jnp.int32),
+                self._batch_arr(block_tables, jnp.int32),
+                self._batch_arr(temperatures, jnp.float32),
+                self._batch_arr(budgets, jnp.int32),
                 done_in,
                 self._next_key())
         return ChunkHandle(out, tok, pos, done)
@@ -1694,15 +1780,16 @@ class JaxExecutor:
             pf_bts[i] = bt
             pf_temps[i] = temp
         fn = self._aot.get("mixed_chunk", self._mixed_chunk)
+        done0 = self._zeros_done()
         with annotate("mixed_chunk"):
             out, tok, pos, done, pf_first, self.cache = fn(
                 self.params, self.cache,
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(positions, jnp.int32),
-                jnp.asarray(block_tables, jnp.int32),
-                jnp.asarray(temperatures, jnp.float32),
-                jnp.asarray(budgets, jnp.int32),
-                jnp.zeros(self.spec.batch_size, bool),
+                self._batch_arr(tokens, jnp.int32),
+                self._batch_arr(positions, jnp.int32),
+                self._batch_arr(block_tables, jnp.int32),
+                self._batch_arr(temperatures, jnp.float32),
+                self._batch_arr(budgets, jnp.int32),
+                done0,
                 jnp.asarray(pf_toks), jnp.asarray(pf_poss),
                 jnp.asarray(pf_lens), jnp.asarray(pf_bts),
                 jnp.asarray(pf_temps),

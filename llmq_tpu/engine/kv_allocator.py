@@ -23,6 +23,17 @@ resident in HBM between turns, and unpins exactly when the conversation
 service evicts it (state_manager on_evict hook) or the pin TTL/pool
 pressure reclaims it — the HBM analogue of the reference's conversation
 TTL cleanup (state_manager.go:354-403).
+
+**dp-sharded serving** (``dp_shards`` > 1, docs/multihost.md): the
+device pool's PAGE axis is partitioned over the mesh's ``dp`` axis, so
+page ids ``[d·P/dp, (d+1)·P/dp)`` physically live on dp replica ``d``.
+This allocator mirrors that split on the host: the id space becomes
+``dp_shards`` universes with independent free lists, and ``alloc``
+takes the universe of the requesting batch row's dp shard — a
+sequence's pages land on the chips that compute its rows, so
+steady-state paged reads/writes never cross dp. ``shard=None`` (and
+the whole API at ``dp_shards=1``) is byte-identical to the unsharded
+allocator.
 """
 
 from __future__ import annotations
@@ -32,27 +43,61 @@ from typing import Dict, List, Optional
 
 
 class PageAllocator:
-    def __init__(self, num_pages: int, page_size: int) -> None:
+    def __init__(self, num_pages: int, page_size: int,
+                 dp_shards: int = 1) -> None:
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
+        dp_shards = max(1, int(dp_shards))
+        if dp_shards > 1 and num_pages % dp_shards != 0:
+            raise ValueError(
+                f"num_pages ({num_pages}) must divide evenly into "
+                f"{dp_shards} dp shards")
         self.num_pages = num_pages
         self.page_size = page_size
-        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # 1..P-1
+        self.dp_shards = dp_shards
+        #: Pages per dp universe (= the device pool's per-shard page
+        #: count when dp-sharded).
+        self.pages_per_shard = num_pages // dp_shards
+        # One LIFO free list per universe. Shard 0 excludes reserved
+        # page 0; at dp_shards=1 this is exactly the old single list
+        # (same order, so alloc sequences are unchanged).
+        self._free_by_shard: List[List[int]] = []
+        for d in range(dp_shards):
+            lo = d * self.pages_per_shard + (1 if d == 0 else 0)
+            hi = (d + 1) * self.pages_per_shard
+            self._free_by_shard.append(list(range(hi - 1, lo - 1, -1)))
         self._refs: Dict[int, int] = {}        # page id → holder count
         self._pins: Dict[str, List[int]] = {}
         self._mu = threading.Lock()
 
+    # -- dp universes --------------------------------------------------------
+
+    def shard_of(self, page: int) -> int:
+        """dp universe a page id belongs to (always 0 unsharded)."""
+        return page // self.pages_per_shard
+
     # -- allocation ----------------------------------------------------------
 
-    def alloc(self, n: int) -> Optional[List[int]]:
+    def alloc(self, n: int,
+              shard: Optional[int] = None) -> Optional[List[int]]:
         """Allocate ``n`` pages (each at refcount 1), or None if the pool
-        can't satisfy all of them (all-or-nothing)."""
+        can't satisfy all of them (all-or-nothing). ``shard`` pins the
+        allocation to one dp universe — a sequence's pages must live
+        with its batch rows; None picks the fullest universe (exactly
+        the old behavior when ``dp_shards == 1``). All ``n`` pages come
+        from ONE universe either way."""
         if n <= 0:
             return []
         with self._mu:
-            if len(self._free) < n:
+            if shard is None:
+                free = max(self._free_by_shard, key=len)
+            else:
+                if not 0 <= shard < self.dp_shards:
+                    raise ValueError(f"bad dp shard {shard}")
+                free = self._free_by_shard[shard]
+            if len(free) < n:
                 return None
-            pages = [self._free.pop() for _ in range(n)]
+            pages = [free.pop() for _ in range(n)]
             for p in pages:
                 self._refs[p] = 1
         return pages
@@ -84,7 +129,7 @@ class PageAllocator:
                     self._refs[p] = refs - 1
                 else:
                     del self._refs[p]
-                    self._free.append(p)
+                    self._free_by_shard[p // self.pages_per_shard].append(p)
 
     def refcount(self, page: int) -> int:
         """Current holder count (0 = free)."""
@@ -117,9 +162,17 @@ class PageAllocator:
         """Allocatable pages (excludes reserved page 0)."""
         return self.num_pages - 1
 
-    def available(self) -> int:
+    def available(self, shard: Optional[int] = None) -> int:
         with self._mu:
-            return len(self._free)
+            if shard is not None:
+                return len(self._free_by_shard[shard])
+            return sum(len(f) for f in self._free_by_shard)
+
+    def available_by_shard(self) -> List[int]:
+        """Free pages per dp universe (len 1 unsharded) — the truthful
+        per-replica headroom the hbm gauges report on the mesh path."""
+        with self._mu:
+            return [len(f) for f in self._free_by_shard]
 
     def used(self) -> int:
         return self.total - self.available()
@@ -140,7 +193,7 @@ class PageAllocator:
         every /metrics scrape; the decode path's alloc/free must not
         stall behind it)."""
         with self._mu:
-            free = list(self._free)
+            free = [p for f in self._free_by_shard for p in f]
         free.sort()
         if not free:
             return 0.0

@@ -71,13 +71,16 @@ def peak_flops(device_kind: str, quant: str = "") -> float:
 
 
 def decode_mfu(tokens_per_s: float, n_params: int, device_kind: str,
-               quant: str = "") -> float:
+               quant: str = "", n_chips: int = 1) -> float:
     """Decode-phase model FLOPs utilization as a FRACTION: each token
     costs ~2·n_params FLOPs (the dense matmuls; attention is negligible
-    at serving context lengths)."""
+    at serving context lengths). ``n_chips`` scales the denominator to
+    the serving mesh's aggregate peak — a dp2×tp4 engine is measured
+    against 8 chips' FLOPs, not one (docs/multihost.md)."""
     if tokens_per_s <= 0 or n_params <= 0:
         return 0.0
-    return tokens_per_s * 2.0 * n_params / peak_flops(device_kind, quant)
+    return (tokens_per_s * 2.0 * n_params
+            / (peak_flops(device_kind, quant) * max(1, int(n_chips))))
 
 
 #: device_kind substring → peak HBM bandwidth (bytes/s). Decode
@@ -105,20 +108,29 @@ def peak_hbm_bandwidth(device_kind: str) -> float:
 
 def decode_hbm_bw_util(tokens_per_s: float, batch: int,
                        weight_bytes: int, kv_bytes_per_token: int,
-                       mean_context: float, device_kind: str) -> float:
+                       mean_context: float, device_kind: str,
+                       n_chips: int = 1, dp: int = 1) -> float:
     """Achieved HBM-bandwidth utilization of the decode loop as a
     FRACTION: each decode STEP streams the weights once for the whole
     batch plus each row's live KV window (≈ mean_context tokens), and
     steps/s = tokens_per_s / batch. Explicit arithmetic over the model
     constants — a lower bound (activations, page padding and the KV
     writeback are excluded), reported next to MFU so bandwidth-bound
-    kernels are judged on the axis they are actually bound by."""
+    kernels are judged on the axis they are actually bound by.
+
+    Mesh accounting: ``n_chips`` scales the peak like
+    :func:`decode_mfu` (aggregate bandwidth of the serving mesh), and
+    ``dp`` scales the WEIGHT traffic — weights replicate per dp group,
+    so each of the dp replicas streams its own copy of the (tp-
+    sharded) weights every step, while KV pages are globally
+    partitioned and stream once."""
     if tokens_per_s <= 0 or batch <= 0:
         return 0.0
     steps_per_s = tokens_per_s / batch
-    bytes_per_step = (weight_bytes
+    bytes_per_step = (weight_bytes * max(1, int(dp))
                       + batch * kv_bytes_per_token * max(0.0, mean_context))
-    return steps_per_s * bytes_per_step / peak_hbm_bandwidth(device_kind)
+    return (steps_per_s * bytes_per_step
+            / (peak_hbm_bandwidth(device_kind) * max(1, int(n_chips))))
 
 
 def measure_rtt(samples: int = 5) -> float:
@@ -210,6 +222,7 @@ class DeviceTelemetry:
         self.n_params = 0
         self.device_kind = ""
         self.quant = ""
+        self.n_chips = 1
         self.rtt_ms: Optional[float] = None
         # Compile/export-cache surface (executor warmup fills these).
         self._compile: Dict[str, Dict[str, Any]] = {}
@@ -235,10 +248,11 @@ class DeviceTelemetry:
     # -- wiring ---------------------------------------------------------------
 
     def configure_model(self, *, n_params: int = 0, device_kind: str = "",
-                        quant: str = "") -> None:
+                        quant: str = "", n_chips: int = 1) -> None:
         self.n_params = int(n_params)
         self.device_kind = device_kind
         self.quant = quant
+        self.n_chips = max(1, int(n_chips))
 
     def set_hbm_provider(self, fn: Optional[Callable[[], Dict]]) -> None:
         self._hbm_provider = fn
@@ -351,7 +365,7 @@ class DeviceTelemetry:
 
     def mfu(self) -> float:
         return decode_mfu(self.tokens_per_s(), self.n_params,
-                          self.device_kind, self.quant)
+                          self.device_kind, self.quant, self.n_chips)
 
     def _overlap_ratio_locked(self) -> float:
         """Single implementation of overlapped/(overlapped+device) —
@@ -443,7 +457,7 @@ class DeviceTelemetry:
         m.decode_tokens_per_s.labels(self.name).set(rate)
         m.mfu_pct.labels(self.name).set(
             decode_mfu(rate, self.n_params, self.device_kind,
-                       self.quant) * 100.0)
+                       self.quant, self.n_chips) * 100.0)
         hbm = self._hbm()
         if hbm is None:
             return
@@ -492,11 +506,12 @@ class DeviceTelemetry:
                 "decode_tokens_per_s": round(rate, 1),
                 "mfu_pct": round(
                     decode_mfu(rate, self.n_params, self.device_kind,
-                               self.quant) * 100.0, 3),
+                               self.quant, self.n_chips) * 100.0, 3),
                 "model": {
                     "n_params": self.n_params,
                     "device_kind": self.device_kind,
                     "quant": self.quant or "bf16",
+                    "n_chips": self.n_chips,
                 },
                 "host_device_rtt_ms": (round(self.rtt_ms, 2)
                                        if self.rtt_ms is not None

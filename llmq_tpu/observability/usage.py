@@ -198,7 +198,8 @@ class _Agg:
     """One rollup bucket (tenant / priority / engine / conversation)."""
 
     __slots__ = ("requests", "tokens", "prompt_tokens", "device_s",
-                 "waste_s", "kv_page_s", "saved_prefill_device_s")
+                 "waste_s", "kv_page_s", "saved_prefill_device_s",
+                 "first_s")
 
     def __init__(self) -> None:
         self.requests = 0
@@ -208,6 +209,9 @@ class _Agg:
         self.waste_s = 0.0
         self.kv_page_s = 0.0
         self.saved_prefill_device_s = 0.0
+        #: Bucket birth (monotonic) — the denominator of the
+        #: saved-prefill accrual RATE demotion economics ranks by.
+        self.first_s = time.monotonic()
 
     def add(self, rec: "_FinalRecord") -> None:
         self.requests += 1
@@ -407,6 +411,24 @@ class UsageLedger:
             "saved_prefill_device_seconds":
                 round(usage.saved_prefill_device_s, 6),
         }
+
+    def conversation_saved_rate(self, conversation: str) -> float:
+        """Demotion economics v2 (ROADMAP 4c, docs/tiering.md): the
+        conversation's ``saved_prefill_device_seconds`` ACCRUAL RATE —
+        measured device-seconds of prefill its cached KV saves per
+        wall-second of existence. The tiering plane ranks evictions by
+        this (evict the lowest expected recompute cost first); a
+        conversation the ledger has never credited scores 0.0, which
+        degrades the ranking to exact LRU."""
+        if not self.enabled:
+            return 0.0
+        now = time.monotonic()
+        with self._mu:
+            agg = self._by_conversation.get(conversation)
+            if agg is None or agg.saved_prefill_device_s <= 0.0:
+                return 0.0
+            return agg.saved_prefill_device_s / max(now - agg.first_s,
+                                                    1.0)
 
     def add_pinned_kv(self, tenant: str, conversation: str,
                       page_s: float) -> None:

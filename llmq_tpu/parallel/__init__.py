@@ -22,9 +22,12 @@ from llmq_tpu.parallel.mesh import (  # noqa: F401
     distributed_init,
 )
 from llmq_tpu.parallel.sharding import (  # noqa: F401
+    LLAMA_PARTITION_RULES,
     batch_sharding,
     kv_cache_shardings,
+    match_partition_rules,
     param_shardings,
     replicated,
+    resolve_rules,
     shard_params,
 )

@@ -354,6 +354,15 @@ class KVTieringPlane:
         self.store_spill = bool(getattr(cfg, "store_spill", True))
         self.promote_timeout_s = float(
             getattr(cfg, "promote_timeout_s", 5.0))
+        #: Demotion economics (ROADMAP 4c): "saved_rate" ranks every
+        #: eviction (HBM pin reclaim via the engine, host→store spill
+        #: here) by the usage ledger's per-conversation
+        #: saved_prefill_device_seconds accrual rate — the measured
+        #: recompute cost the eviction forfeits — with LRU as the
+        #: tiebreak and the exact fallback when the ledger has no
+        #: signal. "lru" restores pure recency.
+        self.eviction_policy = str(
+            getattr(cfg, "eviction_policy", "lru"))
         #: Conversation store with the KV-payload seam (save_kv/
         #: load_kv/delete_kv — persistence.py); feature-detected, so a
         #: plain store simply disables the spill tier.
@@ -571,11 +580,26 @@ class KVTieringPlane:
         pooled, victim.pooled = victim.pooled, False
         return bufs, pooled
 
+    def _evict_key(self, entry: TierEntry) -> Tuple[float, float]:
+        """Eviction ranking — LOWEST evicts first. Under "saved_rate"
+        (demotion economics v2) the primary key is the usage ledger's
+        measured saved-prefill accrual rate: a conversation whose
+        cached KV keeps saving device-seconds outlives one that
+        doesn't, regardless of recency. last_used is the tiebreak and
+        the whole key under "lru" (or whenever the ledger has no
+        signal — every rate is then 0.0 and the sort IS LRU)."""
+        if self.eviction_policy == "saved_rate":
+            from llmq_tpu.observability.usage import get_usage_ledger
+            return (get_usage_ledger().conversation_saved_rate(
+                entry.conv_id), entry.last_used)
+        return (0.0, entry.last_used)
+
     def _coldest_host_entry(
             self) -> Optional[Tuple[TierEntry, List[np.ndarray], bool]]:
-        """Worker: claim the coldest spillable host entry — ready
-        drops (a concurrent promotion waits it out) and the payload
-        ownership transfers to the caller, all under the lock."""
+        """Worker: claim the coldest (lowest :meth:`_evict_key`)
+        spillable host entry — ready drops (a concurrent promotion
+        waits it out) and the payload ownership transfers to the
+        caller, all under the lock."""
         with self._mu:
             cands = [e for e in self._entries.values()
                      if e.tier == "host" and e.pooled
@@ -583,7 +607,7 @@ class KVTieringPlane:
                      and not e.abandoned and not e.spilling]
             if not cands:
                 return None
-            victim = min(cands, key=lambda e: e.last_used)
+            victim = min(cands, key=self._evict_key)
             bufs, pooled = self._claim_for_spill_locked(victim)
             return victim, bufs, pooled
 
@@ -635,7 +659,7 @@ class KVTieringPlane:
             victims = sorted(
                 (e for e in resident
                  if e.ready.is_set() and not e.abandoned),
-                key=lambda e: e.last_used)[:over]
+                key=self._evict_key)[:over]
             dropped: List[TierEntry] = []
             jobs: List[Tuple[TierEntry, List[np.ndarray], bool]] = []
             for v in victims:
