@@ -57,7 +57,13 @@ LLMQ_BENCH_KV_TIER_CONVS / LLMQ_BENCH_KV_TIER_SECS (conversation count
 and per-rate-point duration for the tiered-KV residency A/B),
 LLMQ_BENCH_MESH (e.g. "dp2xtp4": serve the SLA sweeps through a dp×tp
 mesh — rule-table-sharded params, per-chip paged KV, MFU against
-N-chip peak FLOPs; per-point and headline mesh geometry recorded).
+N-chip peak FLOPs; per-point and headline mesh geometry recorded),
+LLMQ_BENCH_SEED (workload seed: every synthetic generator — Poisson
+arrivals, warm bursts, tier draws — derives its stream from it; same
+seed ⇒ identical schedules, see bench_rng / docs/performance.md),
+LLMQ_BENCH_SCENARIOS (comma list of named scenarios for the scenario
+section) / LLMQ_BENCH_SCENARIO_SCALE / LLMQ_BENCH_SKIP_SCENARIOS
+(per-scenario goodput table from the workload plane, docs/scenarios.md).
 """
 
 from __future__ import annotations
@@ -106,6 +112,19 @@ TIER_MIX = [(Priority.REALTIME, 0.10), (Priority.HIGH, 0.20),
 # and per-point duration below scales with 1/(rate · share).
 TPU_TIER_MIX = [(Priority.REALTIME, 0.25), (Priority.HIGH, 0.25),
                 (Priority.NORMAL, 0.30), (Priority.LOW, 0.20)]
+
+
+def bench_rng(stream: int) -> random.Random:
+    """Workload RNG for the synthetic generators (Poisson arrival
+    schedules, warm bursts, tier draws): every section derives its
+    stream from ``LLMQ_BENCH_SEED`` (default 0) plus a fixed
+    per-section offset — same derivation discipline as the chaos
+    injector — so two runs with the same seed replay identical
+    schedules and a changed seed re-rolls every section at once
+    (docs/performance.md). The default seed reproduces the historical
+    per-section constants exactly."""
+    seed = int(os.environ.get("LLMQ_BENCH_SEED", "0"))
+    return random.Random(seed * 1000003 + stream)
 
 
 def sample_tier(rng: random.Random, mix=TIER_MIX) -> "Priority":
@@ -172,7 +191,7 @@ def bench_queue_throughput(n_msgs: int) -> Dict:
                     done.set()
 
         log(f"[queue] pushing {n_msgs} messages across 4 tiers ...")
-        rng = random.Random(0)
+        rng = bench_rng(0)
         msgs = [Message(id=f"m{i}", content="x", user_id="bench",
                         priority=rng.choice(TIERS)) for i in range(n_msgs)]
         for m in msgs:
@@ -238,7 +257,7 @@ def bench_poisson_echo(rate_per_s: float, duration_s: float) -> Dict:
     for w in workers:
         w.start()
 
-    rng = random.Random(42)
+    rng = bench_rng(42)
     n_sent = 0
     log(f"[poisson] {rate_per_s:.0f} req/s for {duration_s:.0f}s "
         f"(echo engine, 64 slots) ...")
@@ -369,7 +388,7 @@ def bench_tenancy_isolation(rate_per_s: float = 300.0,
             lat["a"].clear()
             lat["b"].clear()
             submit_t.clear()
-        rng = random.Random(7)
+        rng = bench_rng(7)
         n_sent = 0
         n_victim = 0
         nxt = {t: time.perf_counter() for t in offered}
@@ -606,7 +625,7 @@ def bench_controlplane_ramp(base_rate: float = 20.0,
         waste0 = ((snap0.get("totals") or {})
                   .get("waste_device_seconds") or 0.0)
         by_reason0 = dict(snap0.get("waste_by_reason") or {})
-        rng = random.Random(17)
+        rng = bench_rng(17)
         n_sent = 0
         replica_seconds = 0.0
         peak_live = 0
@@ -828,7 +847,7 @@ def bench_kv_tiering(n_convs: int = 640, rates=(50.0, 150.0),
         # spread uniformly over the long tail (host-tier promotions) —
         # the realistic mix, and it gives the promote-hidden gate
         # comparable per-tier sample sizes within ONE workload.
-        rng = random.Random(42)
+        rng = bench_rng(42)
         hot = min(32, n_convs)
         handles = []
         nxt = time.perf_counter()
@@ -939,6 +958,56 @@ def bench_kv_tiering(n_convs: int = 640, rates=(50.0, 150.0),
         f"({out['resident_multiplier']}×), p99 ratios "
         f"{out['p99_ratio_at_rates']}, host first-token delta "
         f"{out['tiering'].get('host_first_token_delta_pct')}%")
+    return out
+
+
+# -- 6b. scenario engine: per-scenario goodput --------------------------------
+
+def bench_scenarios(scale: float = 0.1,
+                    names: Optional[List[str]] = None) -> Dict:
+    """Reduced-scale shipped scenarios on the echo backend
+    (llmq_tpu/scenarios/, docs/scenarios.md): the trace-driven workload
+    plane drives multi-turn conversations closed-loop through the real
+    submit path — FakeClock-compressed — and scores each run from the
+    usage-ledger goodput join. One row per scenario lands in the
+    headline so regressions in scheduling/tenancy/tiering show up as a
+    goodput drop on a NAMED workload, not just a microbench delta."""
+    import logging
+
+    from llmq_tpu.scenarios import run_scenario
+
+    # Scenario runs narrate preemption/eviction per request at INFO —
+    # megabytes on a 10^4-turn run; errors still surface.
+    for noisy in ("llmq.engine", "llmq.supervisor", "llmq.chaos",
+                  "llmq.tiering", "llmq.scenarios"):
+        logging.getLogger(noisy).setLevel(logging.ERROR)
+    names = names or ["agentic_tool_loops", "rag_long_prompt_flood",
+                      "diurnal_tenant_mix_with_flash_crowd"]
+    out: Dict = {"scale": scale, "scenarios": {}}
+    for name in names:
+        t0 = time.perf_counter()
+        rep = run_scenario(name, scale=scale)
+        req = rep["requests"]
+        row = {
+            "goodput_tps": rep["goodput"].get(
+                "tokens_per_device_second"),
+            "slo_attainment": rep["slo"]["attainment"],
+            "share_max_abs_error": rep["share_error"]["max_abs_error"],
+            "waste_ratio": rep["waste"]["ratio"],
+            "completed": req["completed"],
+            "failed": req["failed"],
+            "shed": req["shed"],
+            "chaos_events_fired": req["chaos_events_fired"],
+            "engine_recoveries": req["engine_recoveries"],
+            "compression": rep["duration"]["compression"],
+            "wall_s": round(time.perf_counter() - t0, 2),
+        }
+        out["scenarios"][name] = row
+        log(f"[scenarios] {name}: goodput={row['goodput_tps']} "
+            f"tok/dev-s slo={row['slo_attainment']} "
+            f"completed={row['completed']} shed={row['shed']} "
+            f"chaos={row['chaos_events_fired']} "
+            f"({row['compression']}x compression, {row['wall_s']}s)")
     return out
 
 
@@ -1420,7 +1489,7 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
     # rate so steady-state batching/preemption behavior is reached
     # BEFORE the first measured point (BENCH_r05's 1019 ms @1 req/s vs
     # 572 ms @2 was a cold first point).
-    wrng = random.Random(3)
+    wrng = bench_rng(3)
     warm = [engine.submit(GenRequest(
                 id=f"warm{i}", prompt=f"warm up {i % 8}",
                 priority=sample_tier(wrng, TPU_TIER_MIX),
@@ -1434,7 +1503,7 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         """One open-loop Poisson phase at ``rate`` for ``dur`` seconds;
         returns the measured point, or None when ``collect`` is False
         (discarded warm phase)."""
-        rng = random.Random(7)
+        rng = bench_rng(7)
         handles = []
         t_start = time.perf_counter()
         next_arrival = t_start
@@ -1929,6 +1998,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"[controlplane] ramp bench failed: "
             f"{type(e).__name__}: {e}")
+    scenarios_res = None
+    if not os.environ.get("LLMQ_BENCH_SKIP_SCENARIOS"):
+        try:
+            scenarios_res = bench_scenarios(
+                scale=float(os.environ.get(
+                    "LLMQ_BENCH_SCENARIO_SCALE", "0.1")),
+                names=[n for n in os.environ.get(
+                    "LLMQ_BENCH_SCENARIOS", "").split(",") if n] or None)
+        except Exception as e:  # noqa: BLE001
+            log(f"[scenarios] failed: {type(e).__name__}: {e}")
     tpu = None
     tpu_tiers = None
     tpu_tiers_8b = None
@@ -1965,6 +2044,7 @@ def main() -> None:
         "tenancy": tenancy_res,
         "kv_tiering": kv_tiering_res,
         "controlplane": controlplane_res,
+        "scenario_runs": scenarios_res,
         "tpu": tpu,
         "tpu_tiers": tpu_tiers,
         "tpu_tiers_8b": tpu_tiers_8b,
@@ -1987,6 +2067,12 @@ def main() -> None:
             "controller_realtime_p99_ms":
                 ((controlplane_res or {}).get("controller") or {})
                 .get("realtime_p99_ms"),
+            # Per-scenario goodput table (tokens/device-second, SLO-met
+            # — the north-star metric on each NAMED workload).
+            "scenarios": {
+                name: row.get("goodput_tps")
+                for name, row in ((scenarios_res or {})
+                                  .get("scenarios") or {}).items()},
             "decode_tokens_per_s": (tpu or {}).get("decode_tokens_per_s"),
             "max_rate_realtime_p99_ok":
                 (tpu_tiers or {}).get("max_rate_realtime_p99_ok"),

@@ -718,6 +718,72 @@ def cmd_check(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_scenarios(args) -> int:
+    """Scenario engine (docs/scenarios.md): compile the named (or
+    ``scenarios.run``-configured) workload specs and drive them
+    closed-loop — against an in-process echo engine by default, or a
+    remote gateway with ``--gateway`` — emitting one summary JSON line
+    per run plus ``SCENARIO_<name>.json`` when ``scenarios.emit_json``
+    is on. Exit 1 if any run fails or violates an invariant."""
+    import json
+    import logging
+
+    cfg = _load(args)
+    scn = cfg.scenarios
+    names = list(args.names or scn.run)
+    if not names:
+        if not scn.enabled:
+            log.error("scenarios.enabled is false and no scenario "
+                      "names were given — pass names on the command "
+                      "line or set scenarios.run")
+            return 2
+        from llmq_tpu.scenarios import SHIPPED
+        names = list(SHIPPED)
+    from llmq_tpu.scenarios import GatewayTarget, load_named, run_scenario
+
+    # Scenario runs narrate per-request preemption/eviction at INFO —
+    # megabytes on a 10^4-turn run; warnings and errors still surface.
+    for noisy in ("llmq.engine", "llmq.supervisor", "llmq.tiering"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+    scale = args.scale if args.scale is not None else scn.scale
+    rc = 0
+    for name in names:
+        spec = load_named(name, directory=scn.dir)
+        if spec.seed == 0 and scn.default_seed:
+            spec.seed = scn.default_seed
+        target = GatewayTarget(args.gateway) if args.gateway else None
+        try:
+            rep = run_scenario(spec, target=target, scale=scale,
+                               out_dir=scn.out_dir,
+                               emit_json=scn.emit_json,
+                               directory=scn.dir)
+        except Exception as e:  # noqa: BLE001 — one failed scenario
+            log.error("scenario %s failed: %s: %s",  # must not eat the rest
+                      name, type(e).__name__, e)
+            rc = 1
+            continue
+        req = rep["requests"]
+        violations = rep["invariants"]["violations"]
+        if violations:
+            rc = 1
+        sys.stdout.write(json.dumps({
+            "scenario": name,
+            "scale": scale,
+            "goodput_tps": rep["goodput"].get(
+                "tokens_per_device_second"),
+            "slo_attainment": rep["slo"]["attainment"],
+            "completed": req["completed"],
+            "failed": req["failed"],
+            "shed": req["shed"],
+            "chaos_events_fired": req["chaos_events_fired"],
+            "engine_recoveries": req["engine_recoveries"],
+            "invariant_violations": violations,
+            "report_path": rep.get("report_path"),
+        }) + "\n")
+        sys.stdout.flush()
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="llmq_tpu",
@@ -741,6 +807,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("gateway", help="API edge (no workers/engine)")
     sub.add_parser("scheduler", help="autoscaler monitor loop")
     sub.add_parser("check", help="end-to-end smoke check, then exit")
+    scn = sub.add_parser(
+        "scenarios",
+        help="run workload scenarios closed-loop (docs/scenarios.md)")
+    scn.add_argument("names", nargs="*",
+                     help="scenario names (default: scenarios.run, "
+                          "or all shipped when scenarios.enabled)")
+    scn.add_argument("--scale", type=float, default=None,
+                     help="arrival/population scale factor "
+                          "(default: scenarios.scale)")
+    scn.add_argument("--gateway", default="",
+                     help="drive a remote gateway URL instead of an "
+                          "in-process echo engine")
     args = parser.parse_args(argv)
     return {
         "serve": cmd_serve,
@@ -748,6 +826,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "gateway": cmd_gateway,
         "scheduler": cmd_scheduler,
         "check": cmd_check,
+        "scenarios": cmd_scenarios,
     }[args.command](args)
 
 

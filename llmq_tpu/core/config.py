@@ -421,6 +421,35 @@ class TenancyConfig:
 
 
 @dataclass
+class ScenariosConfig:
+    """Scenario engine (llmq_tpu/scenarios/, docs/scenarios.md):
+    trace-driven workload plane that compiles declarative scenario
+    specs (YAML files under ``dir``) into closed-loop traffic against
+    the real serve path and scores each run with the usage plane's
+    goodput. ``enabled: false`` (the DEFAULT) is a hard off-switch —
+    the package is a tool, never imported by the serving path, so
+    "off" literally means zero import cost."""
+    enabled: bool = False
+    #: Directory holding named scenario YAML specs (the shipped five
+    #: live in configs/scenarios/).
+    dir: str = "configs/scenarios"
+    #: Scenario names to run when the bench/CLI asks for "configured
+    #: scenarios" ([] = every shipped scenario at reduced scale).
+    run: List[str] = field(default_factory=list)
+    #: Global multiplier on arrival rates and conversation caps — the
+    #: same named spec serves as CI smoke (0.05) and full soak (1.0).
+    scale: float = 1.0
+    #: Where ``SCENARIO_<name>.json`` reports are written.
+    out_dir: str = "."
+    #: Write the per-run JSON report (the in-memory report dict is
+    #: returned either way).
+    emit_json: bool = True
+    #: Seed for specs that don't pin one (same spec + seed ⇒ identical
+    #: arrival/turn schedules).
+    default_seed: int = 0
+
+
+@dataclass
 class OverloadConfig:
     """Adaptive overload shedding at the API layer (api/overload.py,
     docs/robustness.md): reject work the system cannot serve within
@@ -885,6 +914,7 @@ class Config:
     controlplane: ControlPlaneConfig = field(
         default_factory=ControlPlaneConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
+    scenarios: ScenariosConfig = field(default_factory=ScenariosConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     executor: ExecutorConfig = field(default_factory=ExecutorConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)

@@ -491,6 +491,15 @@ class QueueMetrics:
             f"{ns}_tenant_inflight",
             "Dispatched (popped, unfinished) messages per tenant",
             ["tenant"], registry=registry)
+        # Unlabeled on purpose: the evicted ids are exactly the ones an
+        # id spray mints, so a per-tenant label would be the cardinality
+        # leak this counter exists to make visible.
+        self.tenant_registry_evictions = Counter(
+            f"{ns}_tenant_registry_evictions_total",
+            "Unconfigured-tenant runtime state evicted by the tenant "
+            "registry's LRU bound (MAX_TRACKED) — nonzero means an id "
+            "spray is churning bucket/counter state",
+            registry=registry)
         # Control plane (llmq_tpu/controlplane/, docs/controlplane.md):
         # the reconcile loop's actions and state. Incremented on the
         # controller tick (2s cadence — not a hot path, no deferred

@@ -1,0 +1,26 @@
+"""Scenario engine: the trace-driven workload plane (docs/scenarios.md).
+
+Compiles declarative scenario specs — phases × arrival processes ×
+client populations × chaos events — into deterministic closed-loop
+traffic against the real serve path, and scores each run with the
+usage plane's goodput instead of p99. A *tool*, not a serving-path
+feature: nothing in llmq_tpu imports this package, so the
+``scenarios.enabled`` off-switch literally means zero import cost.
+"""
+
+from llmq_tpu.scenarios.driver import (EngineTarget,  # noqa: F401
+                                       GatewayTarget, PoolTarget,
+                                       RunStats, ScenarioDriver,
+                                       make_echo_engine)
+from llmq_tpu.scenarios.library import (SHIPPED,  # noqa: F401
+                                        list_scenarios, load_named,
+                                        run_scenario, scenario_dir)
+from llmq_tpu.scenarios.scorer import (build_report,  # noqa: F401
+                                       steady_state_deviation,
+                                       write_report)
+from llmq_tpu.scenarios.spec import (ArrivalSpec,  # noqa: F401
+                                     ChaosEventSpec, CompiledScenario,
+                                     PhaseSpec, PopulationSpec,
+                                     ScenarioSpec, compile_scenario,
+                                     load_scenario_file,
+                                     spec_from_dict)

@@ -1,0 +1,117 @@
+"""Named-scenario library + the one-call run entrypoint.
+
+The shipped scenarios live as YAML specs under ``configs/scenarios/``
+(docs/scenarios.md documents each): ``agentic_tool_loops``,
+``rag_long_prompt_flood``, ``diurnal_tenant_mix_with_flash_crowd``,
+``adversarial_id_spray_quota_probe``, ``conversation_soak_100k``.
+:func:`run_scenario` is what the bench section, the CI lane and the
+tests all call — build (or accept) a target, play the schedule on a
+FakeClock, score, optionally emit ``SCENARIO_<name>.json``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from llmq_tpu.core.clock import Clock
+from llmq_tpu.scenarios.driver import (EngineTarget, ScenarioDriver,
+                                       make_echo_engine)
+from llmq_tpu.scenarios.scorer import build_report, write_report
+from llmq_tpu.scenarios.spec import (ScenarioSpec, load_scenario_file,
+                                     spec_from_dict)
+
+#: The shipped named scenarios (one YAML each under ``scenario_dir``).
+SHIPPED = ("agentic_tool_loops", "rag_long_prompt_flood",
+           "diurnal_tenant_mix_with_flash_crowd",
+           "adversarial_id_spray_quota_probe",
+           "conversation_soak_100k")
+
+
+def scenario_dir(configured: str = "") -> str:
+    """Resolve the scenario spec directory: an explicit setting wins,
+    else the repo's ``configs/scenarios/`` relative to this package.
+    A relative setting that doesn't exist from the current working
+    directory (the config default run from elsewhere) anchors at the
+    repo root instead."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    if configured:
+        if os.path.isabs(configured) or os.path.isdir(configured):
+            return configured
+        return os.path.join(repo, configured)
+    return os.path.join(repo, "configs", "scenarios")
+
+
+def list_scenarios(directory: str = "") -> List[str]:
+    d = scenario_dir(directory)
+    if not os.path.isdir(d):
+        return []
+    return sorted(os.path.splitext(f)[0] for f in os.listdir(d)
+                  if f.endswith((".yaml", ".yml")))
+
+
+def load_named(name: str, directory: str = "") -> ScenarioSpec:
+    """Load one named scenario spec from the scenario directory."""
+    d = scenario_dir(directory)
+    for ext in (".yaml", ".yml"):
+        path = os.path.join(d, name + ext)
+        if os.path.exists(path):
+            return load_scenario_file(path)
+    raise FileNotFoundError(
+        f"scenario {name!r} not found in {d} "
+        f"(known: {list_scenarios(directory)})")
+
+
+def run_scenario(scenario: Any, *, target: Any = None,
+                 scale: float = 1.0, clock: Optional[Clock] = None,
+                 out_dir: str = ".", emit_json: bool = False,
+                 reset_planes: bool = True,
+                 directory: str = "") -> Dict[str, Any]:
+    """Run one scenario end to end and return its report dict.
+
+    ``scenario`` is a name (looked up in the library), a spec dict, or
+    a built :class:`ScenarioSpec`. Without an explicit ``target`` an
+    echo-backend engine is built and torn down around the run; without
+    an explicit ``clock`` a fresh FakeClock compresses the schedule.
+    ``reset_planes`` clears the usage ledger and flight recorder first
+    so the scorecard is this run's, not the process history's."""
+    if isinstance(scenario, str):
+        spec = load_named(scenario, directory)
+    elif isinstance(scenario, dict):
+        spec = spec_from_dict(scenario)
+    else:
+        spec = scenario
+    if clock is None:
+        from llmq_tpu.core.clock import FakeClock
+        clock = FakeClock()
+    own_target = target is None
+    if own_target:
+        target = EngineTarget(make_echo_engine(f"scn-{spec.name}"),
+                              own=True)
+    if reset_planes:
+        from llmq_tpu.observability.recorder import get_recorder
+        from llmq_tpu.observability.usage import get_usage_ledger
+        ledger = get_usage_ledger()
+        ledger.reconfigure(enabled=True)
+        ledger.clear()
+        get_recorder().clear()
+    driver = ScenarioDriver(spec, target, clock=clock, scale=scale)
+    try:
+        stats = driver.run()
+    finally:
+        if own_target:
+            target.stop()
+        if spec.chaos_events:
+            # Disarm: a scenario's leftover rules must never leak into
+            # the next run (or the host process).
+            from llmq_tpu import chaos
+            from llmq_tpu.core.config import ChaosConfig
+            chaos.configure(ChaosConfig(enabled=False))
+    assert driver.compiled is not None
+    report = build_report(driver.compiled, stats,
+                          checker=driver.checker,
+                          engines=target.engines())
+    if emit_json:
+        report["report_path"] = write_report(report, out_dir)
+    return report

@@ -112,6 +112,9 @@ def flush_metrics() -> None:
         return
     for reason, n in reg.drain_rejections().items():
         m.tenant_quota_rejections.labels(reason).inc(n)
+    evicted = reg.drain_evictions()
+    if evicted:
+        m.tenant_registry_evictions.inc(evicted)
     if not reg.enabled:
         return
     from llmq_tpu.observability.usage import get_usage_ledger

@@ -107,6 +107,11 @@ class TenantRegistry:
         #: reason → count, drained at scrape (flush_metrics).
         self._pending_rejections: Dict[str, int] = {}
         self.rejections_total: Dict[str, int] = {}
+        #: LRU evictions of unconfigured-tenant state (id-spray
+        #: visibility): buffered like rejections, drained into
+        #: ``tenant_registry_evictions_total`` at scrape time.
+        self._pending_evictions: int = 0
+        self.evictions_total: int = 0
 
     # -- configuration -------------------------------------------------------
 
@@ -259,6 +264,13 @@ class TenantRegistry:
             out, self._pending_rejections = self._pending_rejections, {}
             return out
 
+    def drain_evictions(self) -> int:
+        """Buffered LRU-eviction count since the last drain (scrape
+        flush → ``tenant_registry_evictions_total``)."""
+        with self._mu:
+            out, self._pending_evictions = self._pending_evictions, 0
+            return out
+
     # -- reads ---------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
@@ -289,6 +301,8 @@ class TenantRegistry:
             self._queued.clear()
             self._pending_rejections.clear()
             self.rejections_total = {}
+            self._pending_evictions = 0
+            self.evictions_total = 0
 
     def _trim_locked(self, lru: "OrderedDict[str, Any]") -> None:
         while len(lru) > self.MAX_TRACKED:
@@ -297,6 +311,8 @@ class TenantRegistry:
             for key in lru:
                 if key not in self._specs:
                     del lru[key]
+                    self._pending_evictions += 1
+                    self.evictions_total += 1
                     break
             else:
                 break
