@@ -17,6 +17,11 @@ Extra fields:
   (docs/tiering.md) — resident warm conversations with a small KV pool
   HBM-only vs the HBM → host → store hierarchy, realtime p99 per rate
   point for both, hit-tier breakdown, host-tier first-token delta.
+- ``disagg``: prefill/decode disaggregation A/B (docs/
+  disaggregation.md) — the compose profile's 2-prefill + 2-decode
+  replica set vs the same four replicas symmetric, under the
+  long-prompt + chatty-realtime mix; realtime p99 both ways and the
+  exchange lifecycle totals from the disagg run.
 - ``controlplane``: 4× traffic ramp A/B (docs/controlplane.md) —
   static 4-replica profile vs controller-managed, reporting realtime
   p99, replica-seconds consumed and the waste decomposition for both.
@@ -55,6 +60,9 @@ LLMQ_BENCH_CONTROLPLANE_RATE / LLMQ_BENCH_CONTROLPLANE_SECS (base
 offered rate and per-phase duration for the control-plane ramp A/B),
 LLMQ_BENCH_KV_TIER_CONVS / LLMQ_BENCH_KV_TIER_SECS (conversation count
 and per-rate-point duration for the tiered-KV residency A/B),
+LLMQ_BENCH_DISAGG_LONG_RATE / LLMQ_BENCH_DISAGG_CHAT_RATE /
+LLMQ_BENCH_DISAGG_SECS (arrival rates and phase duration for the
+disaggregation A/B),
 LLMQ_BENCH_MESH (e.g. "dp2xtp4": serve the SLA sweeps through a dp×tp
 mesh — rule-table-sharded params, per-chip paged KV, MFU against
 N-chip peak FLOPs; per-point and headline mesh geometry recorded),
@@ -961,7 +969,195 @@ def bench_kv_tiering(n_convs: int = 640, rates=(50.0, 150.0),
     return out
 
 
-# -- 6b. scenario engine: per-scenario goodput --------------------------------
+# -- 6b. prefill/decode disaggregation A/B ------------------------------------
+
+def bench_disagg(rate_long: float = 24.0, rate_chat: float = 15.0,
+                 phase_s: float = 4.0) -> Dict:
+    """Prefill/decode disaggregation A/B (docs/disaggregation.md): the
+    compose profile's 2-prefill + 2-decode replica set vs a symmetric
+    4-unified set — the SAME four echo engines (mixed-batch prefill
+    budget, simulated per-step device latency plus per-token prefill
+    compute, tiered KV over one shared store), the same workload, only
+    the role map differs.
+
+    The workload is the ``disagg_long_prompt_handoff`` mix: Poisson
+    long-prompt first turns (~900 byte-tokens — ~72ms of prefill
+    compute spread across the mixed-batch slice train, plus one
+    follow-up) interleaved with Poisson REALTIME chatty conversations
+    (short turns, closed-loop follow-ups). Symmetric, every replica's
+    steps carry long prefill slices, so every co-resident realtime
+    decode row — and every chatty arrival's own first token — pays for
+    them; with roles, the trains are quarantined on the prefill
+    replicas and the follow-up claims its KV through the exchange, so
+    a decode replica prefills only the new turn's tokens. Reports
+    realtime p99 both ways (the beats-symmetric gate) and the exchange
+    lifecycle totals from the disagg run."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from llmq_tpu.cluster.router import ClusterRouter
+    from llmq_tpu.conversation.persistence import InMemoryStore
+    from llmq_tpu.conversation.state_manager import StateManager
+    from llmq_tpu.core.config import (ClusterConfig, ConversationConfig,
+                                      DisaggConfig, KVTieringConfig,
+                                      LoadBalancerConfig,
+                                      MixedBatchConfig)
+    from llmq_tpu.disagg import DisaggCoordinator, KVExchange
+    from llmq_tpu.engine import (ByteTokenizer, EchoExecutor,
+                                 InferenceEngine)
+    from llmq_tpu.loadbalancer import LoadBalancer
+
+    LONG_CHARS, CHAT_TURNS, OUT_TOKENS = 900, 3, 8
+
+    def build_set(disagg: bool):
+        store = InMemoryStore()
+        lb = LoadBalancer(LoadBalancerConfig(
+            strategy="round_robin", health_check_interval=0.0))
+        router = ClusterRouter(lb, config=ClusterConfig(),
+                               enable_metrics=False)
+        if disagg:
+            # The router estimates prompt tokens at ~4 chars/token;
+            # 128 puts the ~900-char long prompts (est ~230) on the
+            # prefill side and the short chat turns on decode.
+            router.disagg = DisaggConfig(enabled=True,
+                                         long_prompt_tokens=128)
+        engines, coords, keep = [], [], []
+        for i in range(4):
+            role = (("prefill" if i < 2 else "decode")
+                    if disagg else "unified")
+            tok = ByteTokenizer()
+            # Simulated device: 2ms per step plus 80µs per prefill
+            # token — a ~900-token first turn costs ~72ms of prefill
+            # compute on whichever replica runs it, and a fused step
+            # carrying its slices is slower for every co-resident
+            # decode row (the continuous-batching prefill stall, which
+            # slice packing bounds but cannot remove). A follow-up that
+            # adopts KV — pinned locally or claimed via the exchange —
+            # prefills only the new turn's tokens.
+            ex = EchoExecutor(batch_size=8, page_size=32,
+                              num_pages=161, max_pages_per_seq=40,
+                              eos_id=tok.eos_id, chunk_size=4,
+                              step_delay_s=0.002,
+                              prefill_delay_per_token_s=80e-6)
+            eng = InferenceEngine(
+                ex, tok, enable_metrics=False,
+                name=f"{'dis' if disagg else 'sym'}{i}",
+                kv_pin_ttl=600.0, max_decode_steps=OUT_TOKENS,
+                mixed_batch=MixedBatchConfig(
+                    enabled=True, prefill_token_budget=64,
+                    max_slices=1),
+                kv_tiering=KVTieringConfig(enabled=True))
+            sm = StateManager(ConversationConfig(cleanup_interval=0),
+                              store=store)
+            eng.attach_conversation_manager(sm)
+            keep.append(sm)
+            if disagg:
+                xchg = KVExchange(store, role=role, metrics=False)
+                coords.append(DisaggCoordinator(
+                    DisaggConfig(enabled=True, role=role), eng, xchg))
+            eng.start()
+            router.register_engine(eng, endpoint_id=f"ep{i}")
+            engines.append(eng)
+        return router, engines, coords, keep
+
+    def run_mode(disagg: bool) -> Dict:
+        router, engines, coords, keep = build_set(disagg)
+        mode = "disagg" if disagg else "symmetric"
+        chat_ms: List[float] = []
+        long_ms: List[float] = []
+        lat_mu = threading.Lock()
+
+        def turn(conv: str, rid: str, content: str, priority,
+                 history: str, sink: List[float]) -> str:
+            m = Message(id=rid, conversation_id=conv, user_id="u",
+                        content=content, priority=priority,
+                        timeout=60.0)
+            if history:
+                m.metadata["history_text"] = history
+            m.metadata["max_new_tokens"] = OUT_TOKENS
+            t0 = time.perf_counter()
+            router.process_fn(None, m)
+            with lat_mu:
+                sink.append((time.perf_counter() - t0) * 1e3)
+            return content + m.response
+
+        def long_conv(idx: int) -> None:
+            conv = f"{mode}-long-{idx}"
+            hist = turn(conv, f"{conv}-t0",
+                        f"rag context {idx} " + "x" * LONG_CHARS,
+                        Priority.NORMAL, "", long_ms)
+            # The follow-up prefers a decode replica: in disagg mode
+            # this is the prefill→decode exchange handoff.
+            turn(conv, f"{conv}-t1", " and therefore?",
+                 Priority.NORMAL, hist, long_ms)
+
+        def chat_conv(idx: int) -> None:
+            conv = f"{mode}-chat-{idx}"
+            hist = ""
+            for t in range(CHAT_TURNS):
+                hist = turn(conv, f"{conv}-t{t}",
+                            f"chat {idx} turn {t} quick question",
+                            Priority.REALTIME, hist, chat_ms)
+                time.sleep(0.03)
+
+        rng = bench_rng(1007)
+        pool = ThreadPoolExecutor(max_workers=64)
+        futs = []
+        nxt_long = time.perf_counter() + rng.expovariate(rate_long)
+        nxt_chat = time.perf_counter() + rng.expovariate(rate_chat)
+        t_end = time.perf_counter() + phase_s
+        n_long = n_chat = 0
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            if now >= nxt_long:
+                nxt_long += rng.expovariate(rate_long)
+                futs.append(pool.submit(long_conv, n_long))
+                n_long += 1
+            if now >= nxt_chat:
+                nxt_chat += rng.expovariate(rate_chat)
+                futs.append(pool.submit(chat_conv, n_chat))
+                n_chat += 1
+            time.sleep(min(0.001, max(0.0, min(nxt_long, nxt_chat)
+                                      - time.perf_counter())))
+        for f in futs:
+            f.result(timeout=120.0)
+        pool.shutdown(wait=True)
+        res = {
+            "long_conversations": n_long,
+            "chat_conversations": n_chat,
+            "chat_turns": len(chat_ms),
+            "realtime_p50_ms": round(pctl(chat_ms, 0.50), 2),
+            "realtime_p99_ms": round(pctl(chat_ms, 0.99), 2),
+            "long_p99_ms": round(pctl(long_ms, 0.99), 2),
+        }
+        if disagg:
+            res["exchange"] = {
+                k: sum(c.exchange.totals[k] for c in coords)
+                for k in ("published", "claimed", "expired",
+                          "fallback")}
+            res["roles"] = {e.name: e.disagg_role for e in engines}
+        for eng in engines:
+            eng.stop()
+        del keep
+        log(f"[disagg] {mode}: realtime p99="
+            f"{res['realtime_p99_ms']}ms over {len(chat_ms)} turns, "
+            f"long p99={res['long_p99_ms']}ms"
+            + (f", exchange={res['exchange']}" if disagg else ""))
+        return res
+
+    out: Dict = {"rate_long_per_s": rate_long,
+                 "rate_chat_per_s": rate_chat,
+                 "symmetric": run_mode(False),
+                 "disagg": run_mode(True)}
+    sym = out["symmetric"]["realtime_p99_ms"]
+    dis = out["disagg"]["realtime_p99_ms"]
+    out["realtime_p99_improvement_pct"] = round(
+        (sym - dis) / max(0.01, sym) * 100.0, 1)
+    log(f"[disagg] realtime p99 {sym}ms symmetric → {dis}ms disagg "
+        f"({out['realtime_p99_improvement_pct']}% better)")
+    return out
+
+
+# -- 6c. scenario engine: per-scenario goodput --------------------------------
 
 def bench_scenarios(scale: float = 0.1,
                     names: Optional[List[str]] = None) -> Dict:
@@ -982,7 +1178,8 @@ def bench_scenarios(scale: float = 0.1,
                   "llmq.tiering", "llmq.scenarios"):
         logging.getLogger(noisy).setLevel(logging.ERROR)
     names = names or ["agentic_tool_loops", "rag_long_prompt_flood",
-                      "diurnal_tenant_mix_with_flash_crowd"]
+                      "diurnal_tenant_mix_with_flash_crowd",
+                      "disagg_long_prompt_handoff"]
     out: Dict = {"scale": scale, "scenarios": {}}
     for name in names:
         t0 = time.perf_counter()
@@ -1988,6 +2185,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"[kv_tiering] residency bench failed: "
             f"{type(e).__name__}: {e}")
+    disagg_res = None
+    try:
+        disagg_res = bench_disagg(
+            rate_long=float(os.environ.get("LLMQ_BENCH_DISAGG_LONG_RATE",
+                                           "24")),
+            rate_chat=float(os.environ.get("LLMQ_BENCH_DISAGG_CHAT_RATE",
+                                           "15")),
+            phase_s=float(os.environ.get("LLMQ_BENCH_DISAGG_SECS", "4")))
+    except Exception as e:  # noqa: BLE001
+        log(f"[disagg] A/B bench failed: {type(e).__name__}: {e}")
     controlplane_res = None
     try:
         controlplane_res = bench_controlplane_ramp(
@@ -2043,6 +2250,7 @@ def main() -> None:
         "tiers": tiers,
         "tenancy": tenancy_res,
         "kv_tiering": kv_tiering_res,
+        "disagg": disagg_res,
         "controlplane": controlplane_res,
         "scenario_runs": scenarios_res,
         "tpu": tpu,
@@ -2062,6 +2270,17 @@ def main() -> None:
             "kv_tier_host_first_token_delta_pct":
                 ((kv_tiering_res or {}).get("tiering") or {})
                 .get("host_first_token_delta_pct"),
+            # Disaggregation A/B (docs/disaggregation.md): realtime
+            # p99 of the chatty side, 2-prefill+2-decode vs the same
+            # four replicas symmetric — positive pct = disagg wins.
+            "disagg_realtime_p99_ms":
+                ((disagg_res or {}).get("disagg") or {})
+                .get("realtime_p99_ms"),
+            "symmetric_realtime_p99_ms":
+                ((disagg_res or {}).get("symmetric") or {})
+                .get("realtime_p99_ms"),
+            "disagg_realtime_p99_improvement_pct":
+                (disagg_res or {}).get("realtime_p99_improvement_pct"),
             "controller_replica_seconds_saved_pct":
                 (controlplane_res or {}).get("replica_seconds_saved_pct"),
             "controller_realtime_p99_ms":

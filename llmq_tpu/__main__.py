@@ -128,6 +128,22 @@ class App:
                                            and cfg.cluster.include_local)
                      else "")
 
+        # Prefill/decode disaggregation plane (llmq_tpu/disagg/,
+        # docs/disaggregation.md): role + KV-exchange wiring over the
+        # SAME conversation store the state manager persists to — the
+        # store tier becomes the cluster-wide handoff channel. Hard
+        # off-switch: disagg.enabled=false builds None and nothing
+        # below changes.
+        self.disagg = None
+        if cfg.disagg.enabled and self.engine is not None:
+            from llmq_tpu.disagg import build_disagg
+            self.disagg = build_disagg(
+                cfg, self.engine, store,
+                enable_metrics=cfg.queue.enable_metrics)
+            if self.disagg is not None:
+                log.info("disagg plane up: role=%s exchange=%s",
+                         self.disagg.role,
+                         self.disagg.exchange is not None)
         # Self-healing control plane (llmq_tpu/controlplane/,
         # docs/controlplane.md): the controller needs the replica-set
         # routing seam, so a serve process WITHOUT configured peers
@@ -146,6 +162,14 @@ class App:
             self.cluster_router.register_engine(self.engine)
             log.info("control plane: cluster router built over the "
                      "local engine")
+
+        if cfg.disagg.enabled and self.cluster_router is not None:
+            # Router-side role steering (after BOTH router-construction
+            # paths): the learned prefill-rate estimator decides which
+            # first turns are "long" enough for a prefill replica.
+            self.cluster_router.disagg = cfg.disagg
+            self.cluster_router.prefill_eta = (
+                self.resource_scheduler.prefill_eta_ms)
 
         # Split-deployment transport (queueing/spool.py): consumer side
         # pulls spooled messages into the local queues and acks results;
@@ -283,6 +307,19 @@ class App:
                 time.sleep(0.05)
             else:
                 idle = False
+        if self.disagg is not None:
+            # Cross-replica prefix migration (docs/disaggregation.md):
+            # every warm conversation this replica still holds goes to
+            # the KV exchange, so peers resume them with store-tier
+            # hits instead of recompute. Bounded flush: the publishes
+            # must be durable before the stop cascade kills the plane.
+            try:
+                if (self.disagg.publish_warm()
+                        and self.disagg.plane is not None):
+                    self.disagg.plane.flush_jobs(
+                        timeout=max(1.0, timeout / 2))
+            except Exception:  # noqa: BLE001 — drain must complete
+                log.exception("drain-time kv migration failed")
         log.info("drain complete (idle=%s)", idle)
         self._drain_idle = idle
         self._drain_done.set()

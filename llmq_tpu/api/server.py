@@ -424,6 +424,14 @@ class ApiServer:
                "time": time.time()}
         if self.engine is not None:
             out["engine"] = "running" if self.engine.running else "stopped"
+            role = getattr(self.engine, "disagg_role", "unified")
+            if role != "unified":
+                # Disagg role advertisement (docs/disaggregation.md):
+                # peers' routers learn the prefill/decode split from
+                # the same probes that learn liveness. Unified replicas
+                # omit the field — pre-disagg health bodies stay
+                # byte-identical.
+                out["role"] = role
         if self.controller is not None:
             # Paused is an OPERATOR state distinct from disabled (a
             # disabled control plane has no controller and no field

@@ -34,6 +34,13 @@ def build_cluster_router(cfg: Config, load_balancer, *,
     router = ClusterRouter(load_balancer, config=ccfg,
                            state_manager=state_manager,
                            enable_metrics=enable_metrics)
+    dcfg = getattr(cfg, "disagg", None)
+    if dcfg is not None and dcfg.enabled:
+        # Role-aware placement (docs/disaggregation.md): the router
+        # steers long first turns to prefill replicas and follow-ups
+        # to decode replicas. Off (the default), nothing is set and
+        # routing is byte-identical to the unified plane.
+        router.disagg = dcfg
     if engine is not None and ccfg.include_local:
         router.register_engine(engine)
     router.register_peers(ccfg.peers)

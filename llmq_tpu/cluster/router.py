@@ -90,6 +90,21 @@ class ClusterRouter(EngineRouter):
         self.affinity_eligible = 0
         self.spills = 0
         self.failovers = 0
+        #: Disagg role-aware routing (docs/disaggregation.md): None
+        #: (disagg off, the default) keeps every decision below
+        #: byte-identical to unified routing — one None check guards
+        #: the whole feature. Set to the DisaggConfig block by
+        #: build_cluster_router.
+        self.disagg = None
+        #: ``fn(est_tokens) -> Optional[float]``: the ResourceScheduler's
+        #: LEARNED prefill ETA in ms (None until the first observation
+        #: — the token-count threshold is the cold-start fallback).
+        self.prefill_eta = None
+        #: endpoint id → operator-pinned role; probes fill the rest
+        #: (transport ``last_health``, local engine ``disagg_role``).
+        self._roles: Dict[str, str] = {}
+        self.role_routes = 0
+        self.handoffs = 0
 
     # -- registration --------------------------------------------------------
 
@@ -124,6 +139,86 @@ class ClusterRouter(EngineRouter):
             eid = url.split("://", 1)[-1].rstrip("/") or url
             self.register_remote(url, endpoint_id=eid,
                                  timeout=self.config.peer_timeout)
+
+    # -- disagg roles (docs/disaggregation.md) --------------------------------
+
+    def set_endpoint_role(self, endpoint_id: str, role: str) -> None:
+        """Pin an endpoint's disagg role (operator/controlplane seam;
+        probes override nothing pinned here)."""
+        with self._mu:
+            self._roles[endpoint_id] = role
+
+    def _role_of(self, ep: Endpoint) -> str:
+        """An endpoint's disagg role: the pinned map, else the local
+        engine's ``disagg_role``, else what the peer's last /health
+        probe advertised (``HttpEngineClient.last_health``). Anything
+        unknown reads "unified" — routable for every preference."""
+        with self._mu:
+            r = self._roles.get(ep.id)
+        if not r:
+            engine = self.engine_for(ep)
+            r = getattr(engine, "disagg_role", None)
+            if not r:
+                health = getattr(engine, "last_health", None)
+                if isinstance(health, dict):
+                    r = health.get("role")
+        return r if r in ("prefill", "decode") else "unified"
+
+    def _role_pref(self, msg: Message,
+                   session: Optional[str]) -> Optional[str]:
+        """Which role should serve this turn, from OBSERVED history
+        (arXiv 2606.01839), or None when disagg is off. Follow-up
+        turns (history_text riding the message, or a recorded
+        placement) prefer decode replicas; first turns prefer prefill
+        when the learned prefill estimator says the prompt would stall
+        a decode replica past ``long_prompt_ms`` (token-count
+        threshold until the estimator has observations)."""
+        dcfg = self.disagg
+        if dcfg is None or not getattr(dcfg, "enabled", False):
+            return None
+        followup = bool(msg.metadata.get("history_text"))
+        if not followup and session and self.state_manager is not None:
+            try:
+                followup = (self.state_manager.placement(session)
+                            is not None)
+            except Exception:  # noqa: BLE001 — a hint, not a gate
+                followup = False
+        if followup:
+            return "decode"
+        est_tokens = max(1, len(msg.content) // 4)
+        eta = None
+        if self.prefill_eta is not None:
+            try:
+                eta = self.prefill_eta(est_tokens)
+            except Exception:  # noqa: BLE001 — estimator is advisory
+                eta = None
+        if eta is not None:
+            return ("prefill" if eta >= float(dcfg.long_prompt_ms)
+                    else "decode")
+        return ("prefill" if est_tokens >= int(dcfg.long_prompt_tokens)
+                else "decode")
+
+    def _role_exclusions(self, pref: Optional[str],
+                         avoid: set) -> set:
+        """Endpoints the role preference steers AWAY from: replicas
+        specialized for the OTHER role (unified replicas serve any
+        preference). Empty — no steering — unless at least one
+        preferred-role/unified endpoint remains selectable: a
+        preference must never turn into a NoEndpointError that plain
+        unified routing would not have raised."""
+        if pref is None:
+            return set()
+        eps = self.lb.endpoints()
+        mismatched = {ep.id for ep in eps
+                      if self._role_of(ep) not in (pref, "unified")}
+        if not mismatched:
+            return set()
+        if not any(ep.id not in avoid and ep.id not in mismatched
+                   for ep in eps):
+            return set()
+        with self._mu:
+            self.role_routes += 1
+        return mismatched
 
     # -- affinity ------------------------------------------------------------
 
@@ -171,12 +266,41 @@ class ClusterRouter(EngineRouter):
         """Pick + book one endpoint. Returns (endpoint, reason)."""
         aff = self.config.affinity
         avoid = self._avoid(tried)
+        # Role steering applies to the FIRST pick only — failover
+        # re-picks go wide open: availability beats specialization.
+        pref = self._role_pref(msg, session) if not tried else None
+        role_avoid = self._role_exclusions(pref, avoid)
+
+        def pick(sid: Optional[str], reason: str) -> "tuple[Endpoint, str]":
+            if role_avoid:
+                try:
+                    return (self.lb.get_endpoint(
+                        msg, session_id=sid,
+                        exclude=avoid | role_avoid), reason)
+                except NoEndpointError:
+                    # The preferred role vanished between the
+                    # exclusion check and the pick — degrade to
+                    # roleless routing, never to an error unified
+                    # routing would not have raised.
+                    pass
+            return (self.lb.get_endpoint(msg, session_id=sid,
+                                         exclude=avoid), reason)
+
         if aff == "prefix" and session and not tried:
             eid = self._affine_endpoint(session)
             if eid is not None:
                 with self._mu:
                     self.affinity_eligible += 1
                 ep = self.lb.get_endpoint_by_id(eid)
+                if role_avoid and eid in role_avoid:
+                    # The conversation's birth replica has the WRONG
+                    # specialization for this turn — the prefill→decode
+                    # handoff (docs/disaggregation.md): deliberately
+                    # leave the affinity, the exchange (or history-text
+                    # recompute) carries the KV across.
+                    with self._mu:
+                        self.handoffs += 1
+                    return pick(None, "handoff")
                 if (ep is not None and ep.load < self.config.spill_load
                         and eid not in avoid):
                     got = self.lb.acquire_endpoint(eid)
@@ -189,16 +313,13 @@ class ClusterRouter(EngineRouter):
                 # adaptive_load).
                 with self._mu:
                     self.spills += 1
-                return (self.lb.get_endpoint(msg, session_id=None,
-                                             exclude=avoid), "spill")
-            return self.lb.get_endpoint(msg, session_id=None,
-                                        exclude=avoid), "select"
+                return pick(None, "spill")
+            return pick(None, "select")
         # "session" keeps the LB's own TTL session map; "none" and the
         # failover re-picks go strategy-only.
         sid = session if (aff == "session" and not tried) else None
         reason = "failover" if tried else "select"
-        return self.lb.get_endpoint(msg, session_id=sid,
-                                    exclude=avoid), reason
+        return pick(sid, reason)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -548,7 +669,8 @@ class ClusterRouter(EngineRouter):
             hits, eligible = self.affinity_hits, self.affinity_eligible
             dispatches, spills = self.dispatches, self.spills
             failovers = self.failovers
-        return {
+            role_routes, handoffs = self.role_routes, self.handoffs
+        out = {
             "dispatches": dispatches,
             "affinity_hits": hits,
             "affinity_eligible": eligible,
@@ -560,3 +682,12 @@ class ClusterRouter(EngineRouter):
             "endpoints": self.lb.get_stats(),
             "breakers": self.breakers.get_stats(),
         }
+        if self.disagg is not None and getattr(self.disagg, "enabled",
+                                               False):
+            out["disagg"] = {
+                "role_routes": role_routes,
+                "handoffs": handoffs,
+                "roles": {ep.id: self._role_of(ep)
+                          for ep in self.lb.endpoints()},
+            }
+        return out

@@ -50,7 +50,13 @@ def build_controller(cfg: Any, router: Any, *,
                                  "enable_metrics", True)
     if pool is None:
         pool = build_pool(cp.pool)
-    return ReplicaController(
+    controller = ReplicaController(
         config=cp, router=router, pool=pool,
         queue_manager=queue_manager, shedder=shedder,
         supervisor=supervisor, enable_metrics=enable_metrics)
+    dcfg = getattr(cfg, "disagg", None)
+    if dcfg is not None and getattr(dcfg, "enabled", False):
+        # Role-aware scaling (docs/disaggregation.md): scale-ups join
+        # the under-represented prefill/decode side.
+        controller.disagg = dcfg
+    return controller

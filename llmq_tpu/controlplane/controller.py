@@ -76,6 +76,10 @@ class ReplicaController:
         #: .breakers) — the act seam.
         self.router = router
         self.pool = pool
+        #: DisaggConfig when the disagg plane is on (set by
+        #: build_controller) — scale-ups then pick which role the new
+        #: replica joins (docs/disaggregation.md "Role-aware scaling").
+        self.disagg: Any = None
         self.queue_manager = queue_manager
         self.supervisor = supervisor
         self._clock = clock or SYSTEM_CLOCK
@@ -447,9 +451,35 @@ class ReplicaController:
             self._seq += 1
             return self._seq
 
+    def _role_for_new_replica(self) -> Optional[str]:
+        """Role-aware scaling (docs/disaggregation.md): a new replica
+        joins the UNDER-represented disagg side of the live set, so
+        scale-ups repair the prefill:decode balance instead of skewing
+        it. Ties (and a so-far-unified set) go to decode — decode
+        capacity is what steady-state token throughput binds on. None
+        when the disagg plane is off (the role env is never set)."""
+        dcfg = self.disagg
+        if dcfg is None or not getattr(dcfg, "enabled", False):
+            return None
+        role_of = getattr(self.router, "_role_of", None)
+        if role_of is None:
+            return None
+        counts = {"prefill": 0, "decode": 0}
+        for e in self.router.lb.endpoints():
+            try:
+                r = role_of(e)
+            except Exception:  # noqa: BLE001 — advisory signal
+                continue
+            if r in counts:
+                counts[r] += 1
+        return ("prefill" if counts["prefill"] < counts["decode"]
+                else "decode")
+
     def _provision_one(self) -> bool:
         if self.pool is None:
             return False
+        role = self._role_for_new_replica()
+        self.pool.role_hint = role
         try:
             ep = self.pool.provision(self._next_seq())
         except Exception:  # noqa: BLE001 — a broken pool must not
@@ -472,6 +502,16 @@ class ReplicaController:
             return False
         ep.metadata.setdefault("pool", True)
         self.router.lb.add_endpoint(ep)
+        if role is not None:
+            # Pin the role in the router immediately: local-engine
+            # pools have no /health advertisement, and a subprocess
+            # replica's first probe may not have landed yet — the
+            # router must steer correctly from the first dispatch.
+            try:
+                self.router.set_endpoint_role(ep.id, role)
+            except AttributeError:
+                pass                   # bare-router test doubles
+            ep.metadata.setdefault("disagg_role", role)
         return True
 
     def pool_decommission(self, ep: Endpoint) -> None:

@@ -73,6 +73,13 @@ class ReplicaPool:
 
     kind = "base"
 
+    #: Disagg role the NEXT provision should give its replica
+    #: (docs/disaggregation.md "Role-aware scaling") — set by the
+    #: controller right before ``provision``; None means unified/no
+    #: preference. Subprocess/exec pools export it as
+    #: ``LLMQ_DISAGG_ROLE`` so the child config picks it up.
+    role_hint: Optional[str] = None
+
     def provision(self, seq: int) -> Optional[Endpoint]:
         raise NotImplementedError
 
@@ -219,6 +226,11 @@ class SubprocessReplicaPool(ReplicaPool):
         # "[]" overrides even a YAML-configured peer list.
         env["LLMQ_CLUSTER_PEERS"] = "[]"
         env["LLMQ_CONTROLPLANE_ENABLED"] = "false"
+        if self.role_hint:
+            # Role-aware scaling: the controller picked which disagg
+            # side this replica joins; the env override reaches the
+            # child's DisaggConfig through _apply_env.
+            env["LLMQ_DISAGG_ROLE"] = str(self.role_hint)
         try:
             proc = subprocess.Popen(cmd, env=env,
                                     stdout=subprocess.DEVNULL,
@@ -297,6 +309,8 @@ class ExecReplicaPool(ReplicaPool):
             return None
         env = dict(os.environ)
         env["LLMQ_REPLICA_SEQ"] = str(seq)
+        if self.role_hint:
+            env["LLMQ_DISAGG_ROLE"] = str(self.role_hint)
         try:
             out = subprocess.run(
                 self.config.provision_cmd, shell=True, env=env,

@@ -50,6 +50,7 @@ class KVPayloadStore(Protocol):
     def save_kv(self, conversation_id: str, blob: bytes) -> None: ...
     def load_kv(self, conversation_id: str) -> Optional[bytes]: ...
     def delete_kv(self, conversation_id: str) -> None: ...
+    def list_kv(self) -> List[str]: ...
 
 
 class InMemoryStore:
@@ -90,6 +91,10 @@ class InMemoryStore:
     def delete_kv(self, conversation_id: str) -> None:
         with self._mu:
             self._kv.pop(conversation_id, None)
+
+    def list_kv(self) -> List[str]:
+        with self._mu:
+            return list(self._kv.keys())
 
     def close(self) -> None:
         pass
@@ -239,6 +244,11 @@ class SqliteStore:
                 "DELETE FROM kv_payloads WHERE conversation_id=?",
                 (conversation_id,))
 
+    def list_kv(self) -> List[str]:
+        cur = self._conn().execute(
+            "SELECT conversation_id FROM kv_payloads")
+        return [r[0] for r in cur.fetchall()]
+
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
@@ -322,6 +332,14 @@ class RedisStore:
 
     def delete_kv(self, conversation_id: str) -> None:
         self._r.delete(self._kvkey(conversation_id))
+
+    def list_kv(self) -> List[str]:
+        pat = f"{self._prefix}kv:"
+        out: List[str] = []
+        for key in self._r.keys(f"{pat}*"):
+            name = key.decode() if isinstance(key, bytes) else str(key)
+            out.append(name[len(pat):])
+        return sorted(out)
 
     def close(self) -> None:
         self._r.close()

@@ -31,6 +31,7 @@ from llmq_tpu.core.types import Priority
 
 VALID_LB_STRATEGIES = ("round_robin", "least_connections", "weighted_random", "adaptive_load")
 VALID_SCHEDULER_STRATEGIES = ("static", "dynamic", "adaptive", "hybrid")
+VALID_DISAGG_ROLES = ("prefill", "decode", "unified")
 
 
 @dataclass
@@ -260,6 +261,52 @@ class ClusterConfig:
     @property
     def enabled(self) -> bool:
         return bool(self.peers)
+
+
+@dataclass
+class DisaggConfig:
+    """Prefill/decode disaggregation plane (llmq_tpu/disagg/,
+    docs/disaggregation.md): specialize replicas by role and hand
+    conversation KV between them through the store tier acting as a
+    cluster-wide KV exchange. Hard off-switch: ``enabled: false`` (the
+    default) builds nothing — routing, tiering and the engine are
+    byte-identical to unified behavior, pinned by test."""
+    enabled: bool = False
+    #: This replica's role: "prefill" serves first turns of long
+    #: prompts, publishes each finished turn's conversation KV to the
+    #: exchange and releases its local pin; "decode" claims published
+    #: KV and serves follow-up turns; "unified" does both (participates
+    #: in the exchange for migration/rehydration only).
+    role: str = "unified"
+    #: First-turn routing threshold in prompt tokens (estimated): at or
+    #: past this, the turn routes to a prefill replica. Used when the
+    #: ResourceScheduler has no learned prefill rate yet.
+    long_prompt_tokens: int = 512
+    #: Learned-rate threshold: when the ResourceScheduler's prefill
+    #: estimator has observations, a first turn whose expected prefill
+    #: time is at or past this many milliseconds is "long".
+    long_prompt_ms: float = 250.0
+    #: Exchange-entry time-to-live: a claim finding an older entry
+    #: deletes it and falls back to recompute (a dead prefill replica's
+    #: publication must never serve stale KV forever).
+    claim_ttl_s: float = 120.0
+    #: Prefill replicas publish each finished turn's conversation KV to
+    #: the exchange and release the local HBM pin (their HBM is for
+    #: prefill throughput, not decode-idle pins).
+    publish_on_finish: bool = True
+    #: On startup, scan the shared KV store for spilled blobs this
+    #: replica owns and re-register them at tier="store" instead of
+    #: orphaning them (replica restart rehydration).
+    rehydrate_on_start: bool = True
+    #: Negative-cache TTL for exchange lookups that missed: a follow-up
+    #: turn re-checks the exchange at most this often (seconds).
+    miss_ttl_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.role not in VALID_DISAGG_ROLES:
+            raise ValueError(
+                f"unknown disagg role {self.role!r}; "
+                f"valid: {VALID_DISAGG_ROLES}")
 
 
 @dataclass
@@ -904,6 +951,7 @@ class Config:
     resource_scheduler: ResourceSchedulerConfig = field(default_factory=ResourceSchedulerConfig)
     loadbalancer: LoadBalancerConfig = field(default_factory=LoadBalancerConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    disagg: DisaggConfig = field(default_factory=DisaggConfig)
     conversation: ConversationConfig = field(default_factory=ConversationConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)

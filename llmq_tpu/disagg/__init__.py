@@ -1,0 +1,23 @@
+"""Prefill/decode disaggregation plane (docs/disaggregation.md).
+
+The store tier becomes a cluster-wide KV exchange: prefill replicas
+serve long first turns and publish finished conversation KV under
+claimable keys; decode replicas claim + inject it through the tiering
+plane's existing promote path; the cluster router places turns by
+role. Hard off-switch: ``disagg.enabled=false`` (the default) builds
+nothing."""
+
+from llmq_tpu.disagg.coordinator import DisaggCoordinator, build_disagg
+from llmq_tpu.disagg.exchange import (
+    EXCHANGE_PREFIX,
+    KVExchange,
+    flush_metrics,
+)
+
+__all__ = [
+    "DisaggCoordinator",
+    "EXCHANGE_PREFIX",
+    "KVExchange",
+    "build_disagg",
+    "flush_metrics",
+]

@@ -1,0 +1,146 @@
+"""Disagg coordinator: wires the exchange into one replica's planes.
+
+The subsystem is deliberately thin — every hard mechanism already
+exists in a tested plane (docs/disaggregation.md "Design"):
+
+- the tiering plane serializes/injects KV and owns the worker thread
+  (``export_to_exchange`` / ``prepare(remote=True)``);
+- the engine fires ``on_conversation_cached`` when a finished turn's
+  KV is adoptable, and ``demote_conversation`` turns the pin into a
+  plane entry without invalidating anything;
+- the cluster router places turns by role (cluster/router.py).
+
+The coordinator is just the role policy: WHO publishes (prefill role
+after each finished turn; anyone at drain), WHO claims (the decode
+side's remote prepare — wired by setting ``plane.exchange``), and the
+restart rehydration call. ``disagg.enabled=false`` builds none of
+this — :func:`build_disagg` returns None and every hook stays at its
+inert default, byte-identical to unified behavior (pinned by
+tests/test_disagg.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from llmq_tpu.core.config import Config
+from llmq_tpu.disagg.exchange import KVExchange
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("disagg")
+
+
+class DisaggCoordinator:
+    """Per-replica disagg wiring: role, exchange, publish/rehydrate
+    hooks. Construct via :func:`build_disagg`."""
+
+    def __init__(self, cfg: Any, engine: Any,
+                 exchange: Optional[KVExchange]) -> None:
+        #: The DisaggConfig block (core/config.py).
+        self.cfg = cfg
+        self.role = str(cfg.role)
+        self.engine = engine
+        self.exchange = exchange
+        plane = getattr(engine, "_tiering", None)
+        self.plane = plane
+        engine.disagg_role = self.role
+        if exchange is not None and plane is not None:
+            # Decode-side receive path: a remote prepare's local miss
+            # becomes an exchange claim on the plane worker.
+            plane.exchange = exchange
+        if (self.role == "prefill"
+                and bool(getattr(cfg, "publish_on_finish", True))
+                and exchange is not None and plane is not None):
+            engine.on_conversation_cached = self._publish_turn
+
+    # -- publish side ---------------------------------------------------------
+
+    def _publish_turn(self, conv_id: str) -> None:
+        """Engine hook (engine thread, after a finished turn pinned its
+        conversation KV): demote the pin into a plane entry, then queue
+        its exchange publication. The plane worker is FIFO, so the
+        demote's extract completes before the publish job reads the
+        payload."""
+        try:
+            self.engine.demote_conversation(conv_id)
+            if self.plane is not None:
+                self.plane.export_to_exchange(conv_id)
+        except Exception:  # noqa: BLE001 — publish is best-effort; the
+            log.exception(         # decode side recomputes on a miss
+                "exchange publish hook failed for %s", conv_id)
+
+    def publish_warm(self) -> int:
+        """Drain-time migration (docs/disaggregation.md "Migration"):
+        push every warm conversation — pinned or already plane-held —
+        to the exchange so peers resume them with store-tier hits
+        instead of recompute. Any role may call this (a draining
+        unified/decode replica migrates too). Returns the number of
+        publish jobs queued."""
+        plane = self.plane
+        if plane is None or self.exchange is None:
+            return 0
+        for cid in self.engine.cached_conversations():
+            try:
+                self.engine.demote_conversation(cid)
+            except Exception:  # noqa: BLE001 — skip; next cid migrates
+                log.exception("drain demote failed for %s", cid)
+        with plane._mu:
+            held = list(plane._entries.keys())
+        n = 0
+        for cid in held:
+            if plane.export_to_exchange(cid):
+                n += 1
+        if n:
+            log.info("drain: published %d warm conversation(s) to the "
+                     "kv exchange", n)
+        return n
+
+    def rehydrate(self) -> int:
+        """Restart recovery: re-adopt owned spilled blobs (engine →
+        plane.rehydrate) so re-arrivals hit the store tier."""
+        try:
+            return int(self.engine.rehydrate_tiered_conversations())
+        except Exception:  # noqa: BLE001 — recovery is best-effort
+            log.exception("disagg rehydrate failed")
+            return 0
+
+    def stats(self) -> dict:
+        out = {"role": self.role,
+               "exchange": self.exchange is not None,
+               "tiering": self.plane is not None}
+        if self.exchange is not None:
+            out.update(self.exchange.stats())
+        return out
+
+
+def build_disagg(cfg: Config, engine: Any, store: Any, *,
+                 enable_metrics: bool = True
+                 ) -> Optional[DisaggCoordinator]:
+    """Build the replica's disagg wiring from the top-level config, or
+    None when ``disagg.enabled`` is false (the hard off-switch: nothing
+    is constructed, no engine hook is touched).
+
+    ``store`` is the conversation store; the exchange needs its
+    KV-payload seam (save_kv/load_kv/delete_kv). Without it — or
+    without the tiering plane (``executor.kv_tiering.enabled``) — the
+    replica still takes a role (the router can steer by it) but cannot
+    publish or claim KV; a warning says so, and every handoff degrades
+    to history-text recompute."""
+    dcfg = cfg.disagg
+    if not dcfg.enabled:
+        return None
+    exchange: Optional[KVExchange] = None
+    if store is not None and hasattr(store, "save_kv"):
+        exchange = KVExchange(
+            store, role=dcfg.role, claim_ttl_s=dcfg.claim_ttl_s,
+            miss_ttl_s=dcfg.miss_ttl_s, metrics=enable_metrics)
+    else:
+        log.warning("disagg enabled but the conversation store has no "
+                    "KV-payload seam; role routing only (no exchange)")
+    if getattr(engine, "_tiering", None) is None:
+        log.warning("disagg enabled without executor.kv_tiering — KV "
+                    "handoffs will recompute from history text "
+                    "(enable kv_tiering for store-tier handoffs)")
+    coord = DisaggCoordinator(dcfg, engine, exchange)
+    if dcfg.rehydrate_on_start:
+        coord.rehydrate()
+    return coord

@@ -1,0 +1,242 @@
+"""Cluster-wide KV exchange: the store tier as a handoff channel.
+
+The tiering plane (docs/tiering.md) already serializes a
+conversation's KV pages into a self-describing blob and round-trips it
+through the conversation store's KV-payload seam. The exchange reuses
+that exact codec and store but changes the key's OWNERSHIP semantics:
+a spill blob belongs to the replica that wrote it, while an exchange
+entry (``xchg:{conv_id}``) is published by one replica for ANY peer to
+claim — the disagg plane's prefill→decode handoff channel
+(docs/disaggregation.md).
+
+Lifecycle rules (pinned by tests/test_disagg.py):
+
+- **publish** stamps a wall-clock ``published_at`` + the publisher's
+  role into the blob's meta sidecar and overwrites any previous entry
+  for the conversation (latest turn wins).
+- **claim is consume**: a successful claim deletes the entry — exactly
+  one decode replica adopts the KV, peers miss and recompute. No
+  distributed lock: the race window is one store round-trip, and the
+  loser's recompute is merely slower, never wrong.
+- **expiry**: an entry older than ``claim_ttl_s`` at claim time is
+  deleted unclaimed (the publisher likely died mid-handoff — the
+  ``KVExchangeExpiredHigh`` alert watches the rate) and the claimer
+  recomputes from the token stream. Never garbage KV, never a hang.
+- **torn blob** → delete + recompute, same as the spill tier's rule.
+
+Telemetry is buffered and flushed at scrape time
+(``disagg.flush_metrics`` ← metrics/registry.exposition), mirroring
+the tiering plane's discipline: publish/claim never touch a label
+child.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from llmq_tpu.tiering.plane import blob_meta, decode_blob, encode_blob
+from llmq_tpu.utils.logging import get_logger
+
+log = get_logger("disagg")
+
+#: Exchange keys live in the same KV-payload namespace as spill blobs;
+#: the prefix keeps restart rehydration (plane.rehydrate) from adopting
+#: a claimable handoff entry as an owned spill.
+EXCHANGE_PREFIX = "xchg:"
+
+_FAMILIES = ("published", "claimed", "expired", "fallback")
+
+
+class KVExchange:
+    """Publish/claim handoff entries in a shared :class:`KVPayloadStore`.
+
+    Thread-safe; every method is one or two store round-trips plus
+    in-memory counting. ``now_fn`` injects time for tests — the
+    default is the WALL clock on purpose (never ``core.clock``):
+    ``published_at`` is compared across OS processes, where a
+    per-process simulated clock has no meaning."""
+
+    def __init__(self, store: Any, *, role: str = "unified",
+                 claim_ttl_s: float = 120.0, miss_ttl_s: float = 5.0,
+                 metrics: bool = True,
+                 now_fn: Optional[Callable[[], float]] = None) -> None:
+        self._store = store
+        #: This replica's disagg role — the label on claimed/fallback
+        #: counts (publish/expired label the PUBLISHING side's role,
+        #: carried in the blob meta).
+        self.role = str(role)
+        self.claim_ttl_s = float(claim_ttl_s)
+        #: Read by the tiering plane's negative cache — how long a
+        #: remote-prepare miss suppresses re-probing the store.
+        self.miss_ttl_s = float(miss_ttl_s)
+        self.metrics_enabled = bool(metrics)
+        # lint: allow-wallclock — cross-process timestamps (see class
+        # docstring); nothing inside one process schedules off this.
+        self._now = now_fn if now_fn is not None else time.time
+        self._mu = threading.Lock()
+        #: family → role-label → buffered count (drained at scrape).
+        self._counts: Dict[str, Dict[str, int]] = {
+            f: {} for f in _FAMILIES}
+        #: Buffered (role, ms) handoff-latency observations.
+        self._handoff_ms: List[Tuple[str, float]] = []
+        #: Lifetime totals for stats()/health — never reset.
+        self.totals: Dict[str, int] = {f: 0 for f in _FAMILIES}
+        _register(self)
+
+    # -- key scheme -----------------------------------------------------------
+
+    @staticmethod
+    def key_for(conv_id: str) -> str:
+        return EXCHANGE_PREFIX + conv_id
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def publish(self, conv_id: str, bufs: List[Any], specs: List[Any],
+                meta: Optional[Dict[str, Any]] = None) -> None:
+        """Write (or overwrite) the claimable entry for ``conv_id``.
+        ``bufs``/``specs`` may be empty (metadata-only handoff —
+        content-free backends, or a payload the publisher lost; the
+        claimer recomputes from ``meta["tokens"]``). Raises on store
+        failure — the caller (plane worker) logs and moves on; the
+        token stream on the publishing side stays the fallback."""
+        m = dict(meta or {})
+        m["published_at"] = self._now()
+        m["role"] = self.role
+        blob = encode_blob(list(bufs), list(specs), meta=m)
+        self._store.save_kv(self.key_for(conv_id), blob)
+        self._count("published", self.role)
+
+    def claim(self, conv_id: str
+              ) -> Optional[Tuple[List[Any], List[Any], Dict[str, Any]]]:
+        """Consume the entry for ``conv_id`` → ``(bufs, specs, meta)``,
+        or None (nothing published / expired / torn / store error —
+        every miss shape degrades to recompute on the caller)."""
+        key = self.key_for(conv_id)
+        try:
+            blob = self._store.load_kv(key)
+        except Exception:  # noqa: BLE001 — store flake → recompute
+            log.exception("exchange load failed for %s", conv_id)
+            self._count("fallback", self.role)
+            return None
+        if blob is None:
+            return None
+        meta = blob_meta(blob) or {}
+        published_at = float(meta.get("published_at") or 0.0)
+        now = self._now()
+        if published_at and now - published_at > self.claim_ttl_s:
+            self._delete(key)
+            self._count("expired", str(meta.get("role") or self.role))
+            log.info("exchange entry for %s expired after %.1fs "
+                     "(publisher dead?); recompute", conv_id,
+                     now - published_at)
+            return None
+        try:
+            bufs, specs = decode_blob(blob)
+        except ValueError:
+            self._delete(key)
+            self._count("fallback", self.role)
+            log.warning("torn exchange blob for %s; recompute", conv_id)
+            return None
+        # Claim = consume: delete BEFORE returning so a racing peer
+        # misses (and merely recomputes) instead of double-adopting.
+        self._delete(key)
+        self._count("claimed", self.role)
+        if published_at:
+            with self._mu:
+                self._handoff_ms.append(
+                    (self.role, max(0.0, (now - published_at) * 1e3)))
+        return bufs, specs, meta
+
+    def note_fallback(self) -> None:
+        """Count a handoff that degraded to recompute OUTSIDE claim()
+        — e.g. the router expected an exchange entry that was never
+        published (prefill replica died before its publish landed)."""
+        self._count("fallback", self.role)
+
+    def _delete(self, key: str) -> None:
+        try:
+            self._store.delete_kv(key)
+        except Exception:  # noqa: BLE001 — best-effort cleanup; an
+            log.exception(          # undeleted entry expires by TTL
+                "exchange delete failed for %s", key)
+
+    # -- visibility -----------------------------------------------------------
+
+    def pending(self) -> List[str]:
+        """Conversation ids with an unclaimed exchange entry (store
+        scan — operator/smoke visibility, not a hot path). Empty when
+        the store has no ``list_kv`` seam."""
+        if not hasattr(self._store, "list_kv"):
+            return []
+        try:
+            keys = self._store.list_kv()
+        except Exception:  # noqa: BLE001
+            log.exception("exchange scan failed")
+            return []
+        n = len(EXCHANGE_PREFIX)
+        return sorted(k[n:] for k in keys
+                      if k.startswith(EXCHANGE_PREFIX))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            out: Dict[str, Any] = dict(self.totals)
+        out["role"] = self.role
+        out["claim_ttl_s"] = self.claim_ttl_s
+        return out
+
+    def _count(self, family: str, role: str) -> None:
+        with self._mu:
+            fam = self._counts[family]
+            fam[role] = fam.get(role, 0) + 1
+            self.totals[family] += 1
+
+    def flush_metrics(self) -> None:
+        """Scrape-time flush: drain the buffered counters/observations
+        into the prometheus families (metrics/registry.py)."""
+        if not self.metrics_enabled:
+            return
+        from llmq_tpu.metrics.registry import get_metrics
+
+        m = get_metrics()
+        with self._mu:
+            counts = {f: dict(v) for f, v in self._counts.items()}
+            for fam in self._counts.values():
+                fam.clear()
+            handoffs, self._handoff_ms = self._handoff_ms, []
+        families = {
+            "published": m.kv_exchange_published,
+            "claimed": m.kv_exchange_claimed,
+            "expired": m.kv_exchange_expired,
+            "fallback": m.kv_exchange_fallback,
+        }
+        for name, per_role in counts.items():
+            for role, n in per_role.items():
+                if n:
+                    families[name].labels(role).inc(n)
+        for role, ms in handoffs:
+            m.kv_handoff_ms.labels(role).observe(ms)
+
+
+# -- flush registry ------------------------------------------------------------
+
+_EXCHANGES: "weakref.WeakSet[KVExchange]" = weakref.WeakSet()
+_EXCHANGES_LOCK = threading.Lock()
+
+
+def _register(xchg: KVExchange) -> None:
+    with _EXCHANGES_LOCK:
+        _EXCHANGES.add(xchg)
+
+
+def flush_metrics() -> None:
+    """Scrape hook: flush every live exchange's buffered telemetry."""
+    with _EXCHANGES_LOCK:
+        exchanges = list(_EXCHANGES)
+    for x in exchanges:
+        try:
+            x.flush_metrics()
+        except Exception:  # noqa: BLE001 — scrape must not fail here
+            log.exception("kv-exchange metric flush failed")

@@ -688,6 +688,15 @@ class InferenceEngine:
         #: Optional ``fn(tokens: int, seconds: float)`` invoked once per
         #: completed prefill (e.g. ResourceScheduler.observe_prefill).
         self.on_prefill_observed = None
+        #: Disaggregation plane (llmq_tpu/disagg/,
+        #: docs/disaggregation.md). ``disagg_role`` is what this
+        #: replica advertises on /health and to the role-aware router;
+        #: ``on_conversation_cached`` fires (engine thread, outside
+        #: self._mu) right after a finished turn pins its conversation
+        #: KV — a prefill replica's coordinator demotes + publishes it
+        #: to the exchange from there. Both default to inert.
+        self.disagg_role = "unified"
+        self.on_conversation_cached = None
 
     # -- submission ----------------------------------------------------------
 
@@ -704,8 +713,19 @@ class InferenceEngine:
         if self._tiering is not None and req.conversation_id:
             # Re-arrival prefetch (docs/tiering.md): a store-tier
             # entry's blob starts loading NOW, overlapping queue wait
-            # and admission instead of serializing with them.
-            self._tiering.prepare(req.conversation_id)
+            # and admission instead of serializing with them. A turn
+            # for a conversation this replica holds nothing for may
+            # live on the disagg exchange — remote=True extends the
+            # prefetch there (docs/disaggregation.md). The REST path
+            # carries no history_text, so conversation identity is the
+            # only handoff signal; prepare() no-ops the remote branch
+            # when no exchange is wired, and misses are negative-cached
+            # per conversation.
+            remote = False
+            if req.history_text or self._tiering.exchange is not None:
+                with self._mu:
+                    remote = req.conversation_id not in self._conv_cache
+            self._tiering.prepare(req.conversation_id, remote=remote)
         with self._mu:
             self._inbox.append(seq)
         self._wake.set()
@@ -905,6 +925,46 @@ class InferenceEngine:
         if kv is None and conv_id in self._conv_busy:
             # An active sequence owns the pages; don't re-cache at finish.
             self._conv_drop_pending.add(conv_id)
+
+    def demote_conversation(self, conv_id: str) -> None:
+        """Release a conversation's HBM pin THROUGH the tiering plane
+        (any thread). Unlike :meth:`drop_conversation` this never
+        invalidates — the token stream and payload survive as a plane
+        entry. The disagg publish path and drain migration use this to
+        turn a warm pin into something the exchange can serialize."""
+        with self._mu:
+            self._drop_conversation_locked(conv_id, invalidate=False)
+        self._flush_tier_notes()
+
+    def rehydrate_tiered_conversations(self) -> int:
+        """Restart recovery (docs/disaggregation.md "Rehydration"):
+        scan the store for spilled KV blobs this replica owns, re-adopt
+        them as ready store-tier entries, and re-register their prefix
+        handles at tier="store" — so a re-arrival after a process
+        restart is a store-tier hit, not a recompute. Returns the
+        number of conversations adopted."""
+        if self._tiering is None:
+            return 0
+        adopted = self._tiering.rehydrate(owner=self.name)
+        sm = self._state_manager
+        if sm is not None:
+            for cid, meta in adopted:
+                try:
+                    # record_prefix_handle never creates — after a
+                    # restart the conversation must be faulted back in
+                    # from the store first (same store the blob lives
+                    # in, so a rehydratable blob implies a loadable
+                    # conversation).
+                    sm.get_or_create(cid)
+                    sm.record_prefix_handle(cid, {
+                        "length": int(meta.get("length") or 0),
+                        "pages": int(meta.get("n_pages") or 0),
+                        "updated_at": self._clock.now(),
+                        "tier": "store"})
+                except Exception:  # noqa: BLE001 — accounting only
+                    log.exception("prefix-handle rehydrate failed "
+                                  "for %s", cid)
+        return len(adopted)
 
     def cached_conversations(self) -> List[str]:
         with self._mu:
@@ -1633,7 +1693,14 @@ class InferenceEngine:
         seq.carry = list(entry.tokens) + (
             [entry.pending] if entry.pending is not None else [])
         if not seq.prompt_ids:
-            seq.prompt_ids = (self.tokenizer.encode(seq.req.prompt)
+            text = seq.req.prompt
+            if not seq.carry and seq.req.history_text:
+                # An entry with NO remembered stream (an exchange-claim
+                # placeholder that degraded before its fetch landed)
+                # must not drop the conversation history — fall back to
+                # the ordinary history-text re-prefill instead.
+                text = seq.req.history_text + seq.req.prompt
+            seq.prompt_ids = (self.tokenizer.encode(text)
                               or [self.tokenizer.bos_id])
         plane.note_promoted(entry, "recompute",
                             (time.perf_counter() - t0) * 1e3)
@@ -3064,6 +3131,7 @@ class InferenceEngine:
                    and reason in ("eos", "length")
                    and len(seq.written_ids) == seq.pos)
         handle_rec = None
+        pinned = False
         if conv and reason in ("eos", "length"):
             # Trim pages past the written length before pinning: decode
             # budgets allocate ahead (and a joined row that finished at
@@ -3113,6 +3181,7 @@ class InferenceEngine:
                         pending=(seq.last_token if reason == "length"
                                  else None))
                     self.allocator.pin(conv, seq.pages)
+                    pinned = True
                     if self._usage.enabled:
                         # Between-turns KV residency: the request's own
                         # meter closes at _finish; the pin meter bills
@@ -3134,6 +3203,17 @@ class InferenceEngine:
                 self._state_manager.record_prefix_handle(conv, handle_rec)
             except Exception:  # noqa: BLE001 — accounting, not a gate
                 log.exception("prefix-handle record failed for %s", conv)
+        if pinned and self.on_conversation_cached is not None:
+            # Disagg publish hook (docs/disaggregation.md): the turn's
+            # conversation KV is pinned and adoptable — a prefill
+            # replica's coordinator demotes + publishes it to the
+            # exchange from here. Outside self._mu (the hook demotes,
+            # which takes the lock itself).
+            try:
+                self.on_conversation_cached(conv)
+            except Exception:  # noqa: BLE001 — publish is best-effort
+                log.exception("on_conversation_cached failed for %s",
+                              conv)
         self._finish(seq, reason)
 
     def _record_trace(self, seq: _Sequence, reason: str) -> None:

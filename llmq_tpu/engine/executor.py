@@ -232,7 +232,8 @@ class EchoExecutor:
                  mixed_prefill_slices: int = 2,
                  mixed_slice_tokens: int = 64,
                  async_chunks: bool = False,
-                 step_delay_s: float = 0.0) -> None:
+                 step_delay_s: float = 0.0,
+                 prefill_delay_per_token_s: float = 0.0) -> None:
         self.spec = ExecutorSpec(batch_size, page_size, num_pages,
                                  max_pages_per_seq, eos_id)
         self.chunk_size = chunk_size
@@ -255,6 +256,13 @@ class EchoExecutor:
         #: benches instant; the overlap smoke sets a couple of ms so
         #: pipeline_overlap_ratio is deterministic, not a thread race.
         self._step_delay_s = max(0.0, float(step_delay_s))
+        #: Simulated prefill compute, proportional to tokens registered
+        #: (a real device's prefill scales with prompt length; the echo
+        #: backend's is otherwise free). 0 by default; the disagg bench
+        #: sets it so long-prompt prefill trains cost wall-clock on
+        #: whichever replica runs them.
+        self._prefill_delay_per_token_s = max(
+            0.0, float(prefill_delay_per_token_s))
         self._devq: Optional[queue.Queue] = None
         self._dev_thread: Optional[threading.Thread] = None
         if not self._async_chunks:
@@ -282,6 +290,8 @@ class EchoExecutor:
     def prefill(self, tokens: List[int], start_pos: int,
                 block_table: np.ndarray, temperature: float,
                 slot: int) -> int:
+        if self._prefill_delay_per_token_s:
+            time.sleep(len(tokens) * self._prefill_delay_per_token_s)
         with self._mu:
             stream = self._register_prefill(slot, list(tokens), start_pos)
         return stream[0] if stream else self.spec.eos_id
@@ -338,6 +348,12 @@ class EchoExecutor:
         sampled next token as of slice i's end — meaningful to the
         engine only for a sequence's FINAL slice."""
         pf_first = np.full(len(pf), self.spec.eos_id, np.int32)
+        if self._prefill_delay_per_token_s:
+            # The fused step pays for its slice tokens: a step carrying
+            # a long prefill train is slower for every co-resident
+            # decode row, exactly the continuous-batching interference.
+            time.sleep(sum(len(toks) for _s, toks, _p, _bt, _t in pf)
+                       * self._prefill_delay_per_token_s)
         with self._mu:
             for i, (slot, toks, start_pos, _bt, _temp) in enumerate(pf):
                 stream = self._register_prefill(slot, list(toks),
@@ -471,6 +487,9 @@ class EchoExecutor:
         def run(h: "EchoChunkHandle") -> None:
             if self._step_delay_s:
                 time.sleep(self._step_delay_s)
+            if self._prefill_delay_per_token_s:
+                time.sleep(sum(len(t) for _s, t, _p in pf_snap)
+                           * self._prefill_delay_per_token_s)
             pf_first = np.full(len(pf_snap), self.spec.eos_id, np.int32)
             with self._mu:
                 for i, (slot, t, sp) in enumerate(pf_snap):

@@ -93,6 +93,11 @@ class HttpEngineClient:
         self.probe_timeout = probe_timeout
         self.name = self.base_url
         self.breaker = breaker
+        #: Last successfully parsed /health body. The role-aware
+        #: cluster router reads the peer's advertised disagg role from
+        #: here (docs/disaggregation.md) — probes are the only control
+        #: channel the cluster has, so the role rides them for free.
+        self.last_health: dict = {}
 
     # -- engine-compatible seams --------------------------------------------
 
@@ -131,6 +136,7 @@ class HttpEngineClient:
                 return "timeout"
             return "bad_response" if isinstance(e, ValueError) \
                 else "http_error"
+        self.last_health = data
         # A serve peer reports its engine thread; "stopped" means the
         # process is up but cannot generate — unhealthy for routing. A
         # peer that announces status "draining" (SIGTERM / admin drain,

@@ -79,7 +79,7 @@ LABEL_CONTRACT = {
                         # ledger (max_tenants + "other" collapse;
                         # id-shaped values never become labels)
     "reason": frozenset({"affinity", "spill", "select", "failover",
-                         "backlog", "sla", "engine_down",
+                         "handoff", "backlog", "sla", "engine_down",
                          # usage-plane waste decomposition
                          # (observability/usage.py WASTE_REASONS):
                          "retry", "crash", "preempt", "shed",
@@ -110,6 +110,10 @@ LABEL_CONTRACT = {
     # conversation's KV lives / what served a re-arrival. Closed enum
     # — "recompute" appears on hits only (nothing resides there).
     "tier": frozenset({"hbm", "host", "store", "recompute"}),
+    # Disaggregation plane (llmq_tpu/disagg/, docs/disaggregation.md):
+    # which role this replica plays in the prefill/decode split.
+    # Closed enum — mirrors core.config.VALID_DISAGG_ROLES.
+    "role": frozenset({"prefill", "decode", "unified"}),
     "point": None,      # compiled-in chaos fault points (fnmatch keys)
     "kind": frozenset({"error", "timeout", "partial", "oserror",
                        "latency", "crash"}),
@@ -220,6 +224,37 @@ class QueueMetrics:
             "dispatch + entry registration; the device→host transfer "
             "runs on the tiering worker)", ["engine"],
             buckets=_STEP_MS_BUCKETS, registry=registry)
+        # Disaggregation plane (llmq_tpu/disagg/, docs/
+        # disaggregation.md): the KV exchange's lifecycle counters and
+        # the publish→claim handoff latency. ``role`` is the PUBLISHING
+        # side for published/expired (who wrote the entry the event is
+        # about is unknowable at claim time — the claimer labels with
+        # its OWN role for claimed/fallback). Flushed at scrape
+        # (disagg.flush_metrics) — publish/claim only buffer.
+        self.kv_exchange_published = Counter(
+            f"{ns}_kv_exchange_published_total",
+            "Conversation KV entries published to the cluster-wide "
+            "exchange (store tier under claimable keys)", ["role"],
+            registry=registry)
+        self.kv_exchange_claimed = Counter(
+            f"{ns}_kv_exchange_claimed_total",
+            "Exchange entries claimed (consumed) by a replica",
+            ["role"], registry=registry)
+        self.kv_exchange_expired = Counter(
+            f"{ns}_kv_exchange_expired_total",
+            "Exchange entries found past claim_ttl_s at claim time "
+            "(publisher likely died mid-handoff; claimer recomputed)",
+            ["role"], registry=registry)
+        self.kv_exchange_fallback = Counter(
+            f"{ns}_kv_exchange_fallback_total",
+            "Handoffs that degraded to recompute (torn blob, store "
+            "error, or no published entry for a routed conversation)",
+            ["role"], registry=registry)
+        self.kv_handoff_ms = Histogram(
+            f"{ns}_kv_handoff_ms",
+            "Publish→claim latency for exchange entries (wall clock "
+            "across processes — how long KV waited in the exchange)",
+            ["role"], buckets=_STEP_MS_BUCKETS, registry=registry)
         # Mixed prefill+decode batching (docs/architecture.md "Mixed
         # step"): per-iteration occupancy of the fused program, plus
         # the decode-stall attribution histogram. ``path`` on the stall
@@ -590,6 +625,13 @@ def exposition() -> bytes:
         # the buffered demote/promote histograms (docs/tiering.md).
         from llmq_tpu.tiering import flush_metrics as tiering_flush
         tiering_flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Disaggregation plane: buffered exchange lifecycle counters +
+        # handoff-latency observations (docs/disaggregation.md).
+        from llmq_tpu.disagg import flush_metrics as disagg_flush
+        disagg_flush()
     except Exception:  # noqa: BLE001
         pass
     try:

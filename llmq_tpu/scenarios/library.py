@@ -3,7 +3,8 @@
 The shipped scenarios live as YAML specs under ``configs/scenarios/``
 (docs/scenarios.md documents each): ``agentic_tool_loops``,
 ``rag_long_prompt_flood``, ``diurnal_tenant_mix_with_flash_crowd``,
-``adversarial_id_spray_quota_probe``, ``conversation_soak_100k``.
+``adversarial_id_spray_quota_probe``, ``conversation_soak_100k``,
+``disagg_long_prompt_handoff``.
 :func:`run_scenario` is what the bench section, the CI lane and the
 tests all call — build (or accept) a target, play the schedule on a
 FakeClock, score, optionally emit ``SCENARIO_<name>.json``.
@@ -25,7 +26,7 @@ from llmq_tpu.scenarios.spec import (ScenarioSpec, load_scenario_file,
 SHIPPED = ("agentic_tool_loops", "rag_long_prompt_flood",
            "diurnal_tenant_mix_with_flash_crowd",
            "adversarial_id_spray_quota_probe",
-           "conversation_soak_100k")
+           "conversation_soak_100k", "disagg_long_prompt_handoff")
 
 
 def scenario_dir(configured: str = "") -> str:

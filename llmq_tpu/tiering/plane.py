@@ -228,16 +228,23 @@ def unpack_pages(bufs: List[np.ndarray],
 
 
 def encode_blob(bufs: List[np.ndarray],
-                specs: List[Tuple[Tuple[int, ...], np.dtype]]) -> bytes:
+                specs: List[Tuple[Tuple[int, ...], np.dtype]],
+                meta: Optional[Dict[str, Any]] = None) -> bytes:
     """Self-describing store blob: magic + JSON header (leaf specs +
-    page count) + the concatenated per-page payload bytes. Int8 scale
-    pools ride as ordinary leaves — the specs describe whatever the
-    executor's cache tree holds."""
-    header = json.dumps({
+    page count + optional ``meta`` sidecar) + the concatenated per-page
+    payload bytes. Int8 scale pools ride as ordinary leaves — the
+    specs describe whatever the executor's cache tree holds. ``meta``
+    carries the conversation's restart/handoff envelope (token stream,
+    length, owner — see :meth:`KVTieringPlane.rehydrate` and the
+    disagg exchange); pre-meta blobs decode unchanged."""
+    header_obj: Dict[str, Any] = {
         "specs": [[list(shape), np.dtype(dtype).name]
                   for shape, dtype in specs],
         "n_pages": len(bufs),
-    }).encode()
+    }
+    if meta is not None:
+        header_obj["meta"] = meta
+    header = json.dumps(header_obj).encode()
     parts = [_BLOB_MAGIC, len(header).to_bytes(8, "big"), header]
     parts.extend(bytes(b) for b in bufs)
     return b"".join(parts)
@@ -264,6 +271,22 @@ def decode_blob(blob: bytes) -> Tuple[
     bufs = [np.frombuffer(blob[off + j * per:off + (j + 1) * per],
                           np.uint8).copy() for j in range(n)]
     return bufs, specs
+
+
+def blob_meta(blob: bytes) -> Optional[Dict[str, Any]]:
+    """Parse ONLY the header's optional ``meta`` sidecar — no payload
+    bytes touched, so a restart scan over many spilled blobs stays
+    cheap. None for foreign/torn/pre-meta blobs (never raises)."""
+    if not blob.startswith(_BLOB_MAGIC):
+        return None
+    off = len(_BLOB_MAGIC)
+    hlen = int.from_bytes(blob[off:off + 8], "big")
+    try:
+        header = json.loads(blob[off + 8:off + 8 + hlen])
+    except ValueError:
+        return None
+    meta = header.get("meta") if isinstance(header, dict) else None
+    return dict(meta) if isinstance(meta, dict) else None
 
 
 # -- entries -------------------------------------------------------------------
@@ -367,6 +390,17 @@ class KVTieringPlane:
         #: load_kv/delete_kv — persistence.py); feature-detected, so a
         #: plain store simply disables the spill tier.
         self.store: Any = None
+        #: Cluster-wide KV exchange (disagg plane — duck-typed
+        #: ``KVExchange`` with publish/claim, never imported here so
+        #: tiering stays standalone). When set, :meth:`prepare` with
+        #: ``remote=True`` turns a local miss into an exchange claim:
+        #: the promote path IS the receive path.
+        self.exchange: Any = None
+        #: Negative cache for exchange lookups (conv_id → miss time):
+        #: a conversation the exchange didn't hold is not re-probed
+        #: for the exchange's ``miss_ttl_s`` — the store round-trip is
+        #: the expensive part of a miss.
+        self._xchg_miss: Dict[str, float] = {}
         self._entries: Dict[str, TierEntry] = {}
         self._store_ids: set = set()   # conv ids with a spilled blob
         self._mu = threading.Lock()
@@ -420,6 +454,16 @@ class KVTieringPlane:
                 fn()
             except Exception:  # noqa: BLE001 — one failed job must not
                 log.exception("kv-tiering job failed")  # kill the lane
+
+    def flush_jobs(self, timeout: float = 5.0) -> bool:
+        """Wait (bounded) for every already-queued worker job to
+        finish — the lane is FIFO, so a sentinel landing means all
+        prior spills/publishes hit the store. Drain-time migration
+        (docs/disaggregation.md) calls this so queued exchange
+        publications are durable before the process exits."""
+        done = threading.Event()
+        self._submit(done.set)
+        return done.wait(timeout)
 
     def stop(self) -> None:
         w, self._worker = self._worker, None
@@ -545,7 +589,8 @@ class KVTieringPlane:
             tmp = [np.empty(self.pool.page_nbytes, np.uint8)
                    for _ in range(entry.n_pages)]
             pack_pages(leaves, tmp)
-            if self._spill_blob(entry.conv_id, tmp):
+            if self._spill_blob(entry.conv_id, tmp,
+                                self._entry_meta(entry)):
                 self._publish(entry, "store", None, pooled=False)
             else:
                 self._publish(entry, "recompute", None, pooled=False)
@@ -619,16 +664,33 @@ class KVTieringPlane:
         if not bufs:
             self._publish(entry, "recompute", None, pooled=False)
             return
-        ok = self._spill_blob(entry.conv_id, bufs)
+        ok = self._spill_blob(entry.conv_id, bufs,
+                              self._entry_meta(entry))
         self._publish(entry, "store" if ok else "recompute", None,
                       pooled=False)
         if pooled:
             self.pool.give(bufs)
 
-    def _spill_blob(self, conv_id: str, bufs: List[np.ndarray]) -> bool:
+    def _entry_meta(self, entry: TierEntry) -> Dict[str, Any]:
+        """Restart/handoff envelope riding the blob header: everything
+        a peer (or this replica after a restart) needs to rebuild the
+        TierEntry without the original process's memory."""
+        return {
+            "conv_id": entry.conv_id,
+            "tokens": list(entry.tokens),
+            "length": int(entry.length),
+            "pending": entry.pending,
+            "n_pages": int(entry.n_pages),
+            "owner": self.name,
+            "content_free": bool(self._content_free),
+        }
+
+    def _spill_blob(self, conv_id: str, bufs: List[np.ndarray],
+                    meta: Optional[Dict[str, Any]] = None) -> bool:
         assert self._specs is not None
         try:
-            self.store.save_kv(conv_id, encode_blob(bufs, self._specs))
+            self.store.save_kv(conv_id,
+                               encode_blob(bufs, self._specs, meta=meta))
         except Exception:  # noqa: BLE001 — spill is best-effort
             log.exception("kv spill failed for %s", conv_id)
             with self._mu:
@@ -686,25 +748,240 @@ class KVTieringPlane:
                 and entry.payload is None and not entry.loading
                 and not entry.abandoned and self._store_ok())
 
-    def prepare(self, conv_id: str) -> bool:
+    def prepare(self, conv_id: str, *, remote: bool = False) -> bool:
         """Re-arrival hint (any thread): start pulling a store-tier
         entry's blob back toward the host NOW, so the load overlaps
         queue wait / transport / admission instead of serializing with
         it. Returns True when the plane holds (or is loading) an entry
-        for ``conv_id``."""
+        for ``conv_id``.
+
+        ``remote=True`` (disagg decode role — the caller saw a
+        follow-up turn for a conversation this replica has never
+        served) extends the same overlap to the cluster: a local miss
+        becomes an exchange claim on the worker, materializing as an
+        ordinary store-tier entry the existing claim/inject path
+        consumes — or vanishing again on an exchange miss, degrading
+        to the normal history-text recompute. Misses are negative-
+        cached so a chatty conversation doesn't re-probe the store
+        every turn."""
         start_load = False
+        fetch: Optional[TierEntry] = None
         with self._mu:
             entry = self._entries.get(conv_id)
             if entry is None:
-                return False
-            entry.last_used = self._now()
-            if self._needs_load_locked(entry):
+                xchg = self.exchange
+                if not remote or xchg is None:
+                    return False
+                now = self._now()
+                miss = self._xchg_miss.get(conv_id)
+                ttl = float(getattr(xchg, "miss_ttl_s", 5.0))
+                if miss is not None and now - miss < ttl:
+                    return False
+                self._xchg_miss.pop(conv_id, None)
+                if len(self._xchg_miss) > 4096:
+                    self._xchg_miss.clear()
+                # Placeholder the claim path can wait on; the worker
+                # either fills it from the exchange or deletes it
+                # (miss → claim() sees "none" → normal admission).
+                entry = TierEntry(conv_id, [], 0, None, 0, now)
+                entry.tier = "store"
+                entry.source_tier = "store"
                 entry.loading = True
-                entry.ready.clear()
-                start_load = True
+                self._entries[conv_id] = entry
+                fetch = entry
+            else:
+                entry.last_used = self._now()
+                if self._needs_load_locked(entry):
+                    entry.loading = True
+                    entry.ready.clear()
+                    start_load = True
+        if fetch is not None:
+            self._submit(lambda: self._exchange_fetch(fetch))
+            return True
         if start_load:
             self._submit(lambda: self._load(entry))
         return True
+
+    def _exchange_fetch(self, entry: TierEntry) -> None:
+        """Worker: claim a peer-published conversation's KV from the
+        exchange and publish it as a ready store-tier entry — the
+        promote path IS the receive path. A miss (nothing published,
+        TTL-expired, torn blob) deletes the placeholder so admission
+        falls through to history-text recompute; a spec mismatch
+        (heterogeneous peer) keeps the token stream but drops the
+        payload — never inject foreign page bytes."""
+        xchg = self.exchange
+        res = None
+        if xchg is not None and not entry.abandoned:
+            try:
+                res = xchg.claim(entry.conv_id)
+            except Exception:  # noqa: BLE001 — claim is best-effort
+                log.exception("kv exchange claim failed for %s",
+                              entry.conv_id)
+        if res is None:
+            with self._mu:
+                if self._entries.get(entry.conv_id) is entry:
+                    del self._entries[entry.conv_id]
+                self._xchg_miss[entry.conv_id] = self._now()
+                entry.abandoned = True
+                entry.ready.set()
+            self._notify()
+            return
+        bufs, specs, meta = res
+        entry.tokens = list(meta.get("tokens") or [])
+        entry.length = int(meta.get("length") or len(entry.tokens))
+        pending = meta.get("pending")
+        entry.pending = int(pending) if pending is not None else None
+        entry.n_pages = int(meta.get("n_pages") or len(bufs))
+        if self._content_free:
+            # Token stream IS the state (echo): a metadata-only host
+            # entry restores with full correctness.
+            self._publish(entry, "host", None, pooled=False)
+            return
+        same_spec = (self._specs is not None and bufs
+                     and len(specs) == len(self._specs)
+                     and all(tuple(a[0]) == tuple(b[0])
+                             and np.dtype(a[1]) == np.dtype(b[1])
+                             for a, b in zip(specs, self._specs)))
+        if same_spec:
+            bufs2 = self.pool.take(len(bufs))
+            if bufs2 is not None:
+                for dst, src in zip(bufs2, bufs):
+                    dst[:len(src)] = src
+                payload, pooled = bufs2, True
+            else:
+                payload, pooled = bufs, False   # transient arrays
+            entry.source_tier = "store"
+            self._publish(entry, "store", payload, pooled=pooled)
+            return
+        if bufs:
+            log.warning("exchange KV for %s has a foreign page spec; "
+                        "recompute", entry.conv_id)
+        self._publish(entry, "recompute", None, pooled=False)
+
+    def export_to_exchange(self, conv_id: str) -> bool:
+        """Queue publication of a held entry's KV to the exchange
+        (disagg prefill role after a finished turn; drain migration).
+        Runs behind any in-flight extract on the single FIFO worker,
+        so the payload is complete before the publish job reads it.
+        Returns True when a publish job was queued."""
+        if self.exchange is None:
+            return False
+        with self._mu:
+            if conv_id not in self._entries:
+                return False
+        self._submit(lambda: self._exchange_publish(conv_id))
+        return True
+
+    def _exchange_publish(self, conv_id: str) -> None:
+        """Worker: serialize a ready entry to the exchange. Host
+        payloads are claimed with EXCLUSIVE ownership for the duration
+        (same discipline as spills — a racing promote-timeout claim
+        must never hand the buffers back mid-serialization) and
+        restored afterwards; store-tier entries republish their blob;
+        payload-less entries ship the metadata envelope alone."""
+        xchg = self.exchange
+        if xchg is None:
+            return
+        with self._mu:
+            entry = self._entries.get(conv_id)
+            if (entry is None or not entry.ready.is_set()
+                    or entry.abandoned or entry.spilling):
+                return
+            tier = entry.tier
+            if entry.payload is not None:
+                bufs, pooled = self._claim_for_spill_locked(entry)
+            else:
+                bufs, pooled = [], False
+        meta = self._entry_meta(entry)
+        try:
+            if bufs:
+                xchg.publish(conv_id, bufs, self._specs or [], meta)
+            elif tier == "store" and self._store_ok():
+                blob = None
+                try:
+                    blob = self.store.load_kv(conv_id)
+                except Exception:  # noqa: BLE001 — degrade to meta-only
+                    log.exception("kv store load for exchange publish "
+                                  "failed for %s", conv_id)
+                sbufs: List[np.ndarray] = []
+                sspecs: List[Tuple[Tuple[int, ...], np.dtype]] = []
+                if blob is not None:
+                    try:
+                        sbufs, sspecs = decode_blob(blob)
+                    except ValueError:
+                        log.warning("corrupt KV blob for %s; publishing "
+                                    "metadata only", conv_id)
+                xchg.publish(conv_id, sbufs, sspecs, meta)
+            else:
+                xchg.publish(conv_id, [], [], meta)
+        except Exception:  # noqa: BLE001 — publish is best-effort;
+            log.exception(                  # recompute stays correct
+                "kv exchange publish failed for %s", conv_id)
+        finally:
+            if bufs:
+                self._publish(entry, tier, bufs, pooled)
+
+    def rehydrate(self, owner: Optional[str] = None
+                  ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Restart recovery: scan the store's KV payloads and re-adopt
+        blobs this replica owns as ready store-tier entries, so a
+        restarted process serves its spilled conversations with store
+        hits instead of orphaning the blobs into recompute. ``owner``
+        (the plane/engine name stamped into each blob's meta at spill
+        time) filters a shared store down to this replica's share;
+        exchange keys and pre-meta blobs are skipped. Returns the
+        adopted ``(conv_id, meta)`` pairs for prefix-handle
+        re-registration."""
+        if not self._store_ok() or not hasattr(self.store, "list_kv"):
+            return []
+        try:
+            ids = list(self.store.list_kv())
+        except Exception:  # noqa: BLE001 — recovery is best-effort
+            log.exception("kv store scan failed during rehydrate")
+            return []
+        adopted: List[Tuple[str, Dict[str, Any]]] = []
+        now = self._now()
+        for cid in ids:
+            if cid.startswith("xchg:"):
+                continue   # exchange entries are claimable, not owned
+            with self._mu:
+                if cid in self._entries:
+                    continue
+            try:
+                blob = self.store.load_kv(cid)
+            except Exception:  # noqa: BLE001
+                log.exception("kv blob read failed for %s", cid)
+                continue
+            if blob is None:
+                continue
+            meta = blob_meta(blob)
+            if meta is None:
+                continue   # pre-meta blob: no envelope to adopt from
+            if owner is not None and meta.get("owner") != owner:
+                continue
+            tokens = list(meta.get("tokens") or [])
+            length = int(meta.get("length") or len(tokens))
+            if not tokens and length > 0:
+                continue   # no recompute fallback — unusable envelope
+            pending = meta.get("pending")
+            entry = TierEntry(
+                cid, tokens, length,
+                int(pending) if pending is not None else None,
+                int(meta.get("n_pages") or 0), now)
+            entry.tier = "store"
+            entry.source_tier = "store"
+            entry.ready.set()
+            with self._mu:
+                if cid in self._entries:
+                    continue
+                self._entries[cid] = entry
+                self._store_ids.add(cid)
+            adopted.append((cid, meta))
+        if adopted:
+            log.info("rehydrated %d spilled conversation(s) from the "
+                     "store tier", len(adopted))
+        return adopted
 
     def _load(self, entry: TierEntry) -> None:
         """Worker: store blob → host payload (published atomically)."""

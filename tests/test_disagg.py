@@ -1,0 +1,996 @@
+"""Prefill/decode disaggregation plane (llmq_tpu/disagg/,
+docs/disaggregation.md): the store tier as a cluster-wide KV exchange.
+
+- KVExchange unit semantics: publish/claim payload fidelity, claim-is-
+  consume, TTL expiry, torn-blob degradation, per-role counters;
+- the hard off-switch: ``disagg.enabled=false`` builds nothing and
+  routing/engine behavior is byte-identical to the unified plane;
+- role-aware routing over the REAL product path (roles advertised via
+  /health, learned from probes): long first turns → prefill replicas,
+  follow-ups → decode, the prefill→decode affinity handoff, and the
+  never-fail guarantee when only wrong-role replicas remain;
+- plane-level cross-replica exchange: payload round-trip bit-exact
+  through two planes sharing one store, miss negative-caching, foreign
+  page-spec refusal (recompute, never inject);
+- conversation-level handoff on echo engines: prefill publishes each
+  finished turn, decode claims it with a store-tier hit and ZERO
+  recompute; expired claims fall back to history-text recompute with
+  identical output; drain-time warm migration;
+- replica restart rehydration: owned store blobs re-adopted, prefix
+  handles re-registered, re-arrivals hit the store tier;
+- metric families + scrape-time flush;
+- role-aware control-plane scaling (under-represented side wins).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from llmq_tpu.api.server import ApiServer
+from llmq_tpu.cluster import build_cluster_router
+from llmq_tpu.conversation.persistence import InMemoryStore
+from llmq_tpu.conversation.state_manager import StateManager
+from llmq_tpu.core.config import (ClusterConfig, ConversationConfig,
+                                  DisaggConfig, KVTieringConfig,
+                                  LoadBalancerConfig, PrefixCacheConfig,
+                                  default_config)
+from llmq_tpu.core.types import Message
+from llmq_tpu.disagg import (DisaggCoordinator, KVExchange, build_disagg,
+                             flush_metrics)
+from llmq_tpu.engine.engine import GenRequest, InferenceEngine
+from llmq_tpu.engine.executor import EchoExecutor
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.loadbalancer import LoadBalancer
+from llmq_tpu.observability.usage import get_usage_ledger
+from llmq_tpu.tiering import KVTieringPlane
+
+
+@pytest.fixture(autouse=True)
+def _usage_off():
+    led = get_usage_ledger()
+    led.reconfigure(enabled=False)
+    led.clear()
+    yield
+    led.reconfigure(enabled=False)
+    led.clear()
+
+
+def wait_until(fn, timeout=5.0, step=0.002):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+class FakeKVExec:
+    """Numpy 'device' with a deterministic per-page payload (same shape
+    as the tiering tests') so exchange fidelity is assertable."""
+
+    def __init__(self):
+        self.injected = {}
+
+    def kv_page_spec(self):
+        return [((2, 4, 8), np.dtype(np.float32))]
+
+    def export_kv_pages(self, pages):
+        out = np.stack(
+            [np.full((2, 4, 8), float(p), np.float32) for p in pages],
+            axis=1)
+        return [out]
+
+    def import_kv_pages(self, pages, leaves):
+        for i, p in enumerate(pages):
+            self.injected[p] = np.asarray(leaves[0][:, i]).copy()
+
+
+def mk_plane(name="planeA", store=None, cfg=None):
+    plane = KVTieringPlane(cfg or KVTieringConfig(enabled=True), name,
+                           FakeKVExec())
+    plane.store = store if store is not None else InMemoryStore()
+    return plane
+
+
+def _bufs(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, 256, np.uint8).astype(np.uint8)
+            for _ in range(n)]
+
+
+SPECS = [((2, 4, 8), np.dtype(np.float32))]
+
+
+class FakeNow:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- exchange unit semantics ---------------------------------------------------
+
+
+class TestKVExchange:
+    def test_publish_claim_roundtrip_bit_identical(self):
+        store = InMemoryStore()
+        pub = KVExchange(store, role="prefill", metrics=False)
+        sub = KVExchange(store, role="decode", metrics=False)
+        bufs = _bufs(3)
+        meta = {"conv_id": "c", "tokens": [1, 2, 3], "length": 3,
+                "pending": None, "n_pages": 3, "owner": "prefill0"}
+        pub.publish("c", bufs, SPECS, meta)
+        got = sub.claim("c")
+        assert got is not None
+        gbufs, gspecs, gmeta = got
+        assert len(gbufs) == 3
+        for a, b in zip(gbufs, bufs):
+            assert np.array_equal(np.asarray(a)[:256], b)
+        assert [tuple(s[0]) for s in gspecs] == [SPECS[0][0]]
+        assert gmeta["tokens"] == [1, 2, 3]
+        assert gmeta["role"] == "prefill"      # publisher stamped
+        assert "published_at" in gmeta
+        assert pub.totals["published"] == 1
+        assert sub.totals["claimed"] == 1
+
+    def test_claim_is_consume(self):
+        store = InMemoryStore()
+        x = KVExchange(store, metrics=False)
+        x.publish("c", _bufs(1), SPECS, {"conv_id": "c"})
+        assert x.claim("c") is not None
+        assert x.claim("c") is None            # consumed
+        assert KVExchange.key_for("c") not in store.list_kv()
+
+    def test_ttl_expiry_counts_publisher_role(self):
+        now = FakeNow()
+        store = InMemoryStore()
+        pub = KVExchange(store, role="prefill", claim_ttl_s=10.0,
+                         metrics=False, now_fn=now)
+        sub = KVExchange(store, role="decode", claim_ttl_s=10.0,
+                         metrics=False, now_fn=now)
+        pub.publish("c", _bufs(1), SPECS, {"conv_id": "c"})
+        now.t += 11.0
+        assert sub.claim("c") is None
+        assert sub.totals["expired"] == 1
+        # Expired entry was deleted, not left to rot.
+        assert KVExchange.key_for("c") not in store.list_kv()
+
+    def test_torn_blob_counts_fallback(self):
+        store = InMemoryStore()
+        x = KVExchange(store, role="decode", metrics=False)
+        x.publish("c", _bufs(2), SPECS, {"conv_id": "c"})
+        blob = store.load_kv(KVExchange.key_for("c"))
+        store.save_kv(KVExchange.key_for("c"), blob[:-20])  # torn
+        assert x.claim("c") is None
+        assert x.totals["fallback"] == 1
+
+    def test_pending_and_stats(self):
+        store = InMemoryStore()
+        x = KVExchange(store, role="prefill", metrics=False)
+        x.publish("a", _bufs(1), SPECS, {"conv_id": "a"})
+        x.publish("b", _bufs(1), SPECS, {"conv_id": "b"})
+        assert x.pending() == ["a", "b"]
+        st = x.stats()
+        assert st["role"] == "prefill" and st["published"] == 2
+
+
+# -- hard off-switch -----------------------------------------------------------
+
+
+def mk_echo_engine(name="disagg0", tiering=None, metrics=False):
+    tok = ByteTokenizer()
+    ex = EchoExecutor(batch_size=4, page_size=8, num_pages=128,
+                      max_pages_per_seq=16, eos_id=tok.eos_id,
+                      chunk_size=4)
+    return InferenceEngine(ex, tok, enable_metrics=metrics, name=name,
+                           kv_pin_ttl=600.0, kv_tiering=tiering,
+                           prefix_cache=PrefixCacheConfig(enabled=True))
+
+
+def run_turn(eng, rid, prompt, conv, history="", tokens=8):
+    h = eng.submit(GenRequest(id=rid, prompt=prompt,
+                              conversation_id=conv,
+                              history_text=history,
+                              max_new_tokens=tokens))
+    eng.run_until_idle()
+    assert h.result is not None and h.result.finish_reason in (
+        "eos", "length")
+    return h
+
+
+class TestOffSwitch:
+    def test_default_config_disabled(self):
+        cfg = default_config()
+        assert cfg.disagg.enabled is False
+        assert cfg.disagg.role == "unified"
+
+    def test_build_disagg_none_and_engine_hooks_inert(self):
+        cfg = default_config()
+        eng = mk_echo_engine(tiering=KVTieringConfig(enabled=True))
+        assert build_disagg(cfg, eng, InMemoryStore()) is None
+        assert eng.disagg_role == "unified"
+        assert eng.on_conversation_cached is None
+        assert eng._tiering.exchange is None
+        # Serving is the plain unified path.
+        h = run_turn(eng, "t1", "hello off-switch", "c")
+        assert h.result.text
+        eng.stop()
+
+    def test_invalid_role_rejected(self):
+        with pytest.raises(ValueError):
+            DisaggConfig(role="speculate")
+
+    def test_health_omits_role_when_unified(self):
+        eng = mk_echo_engine()
+        api = ApiServer(default_config(), engine=eng)
+        try:
+            assert "role" not in api.health_check(None)[1]
+            eng.disagg_role = "prefill"
+            assert api.health_check(None)[1]["role"] == "prefill"
+        finally:
+            eng.stop()
+
+    def test_router_routes_identically_without_disagg(self):
+        """With disagg unset the role helpers are inert: no exclusions,
+        no disagg stats block, round-robin order unchanged."""
+        from llmq_tpu.cluster.router import ClusterRouter
+        eng_a, eng_b = mk_echo_engine("ra"), mk_echo_engine("rb")
+        lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                             health_check_interval=0.0))
+        eng_a.start()
+        eng_b.start()
+        router = ClusterRouter(lb, config=ClusterConfig(),
+                               enable_metrics=False)
+        router.register_engine(eng_a, endpoint_id="ra")
+        router.register_engine(eng_b, endpoint_id="rb")
+        assert router.disagg is None
+        assert router._role_pref(
+            Message(id="m", content="x" * 4096, user_id="u"), None) is None
+        seen = []
+        for i in range(4):
+            m = Message(id=f"m{i}", content="x" * 4096, user_id="u",
+                        timeout=30.0)
+            router.process_fn(None, m)
+            seen.append(m.metadata["endpoint_id"])
+        assert set(seen) == {"ra", "rb"}      # plain round-robin spread
+        assert "disagg" not in router.get_stats()
+        eng_a.stop()
+        eng_b.stop()
+
+
+# -- role-aware routing (product path: roles learned from /health) -------------
+
+
+def _serve_roled(roles):
+    """One echo replica per role, each behind its own REST server with
+    the role advertised on /health — the only control channel."""
+    engines, servers, urls = [], [], []
+    for i, role in enumerate(roles):
+        eng = mk_echo_engine(f"replica{i}")
+        eng.start()
+        eng.disagg_role = role
+        api = ApiServer(default_config(), engine=eng)
+        port = api.start(host="127.0.0.1", port=0)
+        engines.append(eng)
+        servers.append(api)
+        urls.append(f"http://127.0.0.1:{port}")
+    return engines, servers, urls
+
+
+def _disagg_router(urls, *, state_manager=None, long_prompt_tokens=32,
+                   **ccfg):
+    lb = LoadBalancer(LoadBalancerConfig(strategy="round_robin",
+                                         health_check_interval=0.0))
+    cfg = default_config()
+    cfg.cluster = ClusterConfig(peers=list(urls), **ccfg)
+    cfg.disagg = DisaggConfig(enabled=True,
+                              long_prompt_tokens=long_prompt_tokens)
+    cfg.queue.enable_metrics = False
+    router = build_cluster_router(cfg, lb, state_manager=state_manager)
+    lb.check_health_once()                    # probes learn the roles
+    return router
+
+
+class TestRoleRouting:
+    def test_roles_learned_from_health_probes(self):
+        engines, servers, urls = _serve_roled(["prefill", "decode"])
+        try:
+            router = _disagg_router(urls)
+            roles = {router._role_of(e): e.id
+                     for e in router.lb.endpoints()}
+            assert set(roles) == {"prefill", "decode"}
+        finally:
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+
+    def test_long_first_turn_to_prefill_short_to_decode(self):
+        engines, servers, urls = _serve_roled(["prefill", "decode"])
+        try:
+            router = _disagg_router(urls, long_prompt_tokens=32)
+            by_role = {router._role_of(e): e.id
+                       for e in router.lb.endpoints()}
+            long_turn = Message(id="m1", content="x" * 200,  # ≥32 tok
+                                user_id="u", timeout=30.0)
+            router.process_fn(None, long_turn)
+            assert long_turn.metadata["endpoint_id"] == by_role["prefill"]
+            short = Message(id="m2", content="hi", user_id="u",
+                            timeout=30.0)
+            router.process_fn(None, short)
+            assert short.metadata["endpoint_id"] == by_role["decode"]
+            assert router.get_stats()["disagg"]["role_routes"] >= 2
+        finally:
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+
+    def test_followup_handoff_leaves_prefill_affinity(self):
+        """A conversation born on the prefill replica must NOT return
+        there on turn 2 — the router deliberately breaks affinity
+        (reason "handoff") and the exchange carries the KV across."""
+        engines, servers, urls = _serve_roled(["prefill", "decode"])
+        try:
+            sm = StateManager(ConversationConfig(cleanup_interval=0))
+            sm.get_or_create("conv-h", "u")
+            router = _disagg_router(urls, state_manager=sm,
+                                    long_prompt_tokens=32)
+            by_role = {router._role_of(e): e.id
+                       for e in router.lb.endpoints()}
+            t1 = Message(id="t1", content="y" * 200, user_id="u",
+                         conversation_id="conv-h", timeout=30.0)
+            router.process_fn(None, t1)
+            assert t1.metadata["endpoint_id"] == by_role["prefill"]
+            t2 = Message(id="t2", content="followup", user_id="u",
+                         conversation_id="conv-h", timeout=30.0,
+                         metadata={"history_text": "y" * 200})
+            router.process_fn(None, t2)
+            assert t2.metadata["endpoint_id"] == by_role["decode"]
+            st = router.get_stats()["disagg"]
+            assert st["handoffs"] == 1
+        finally:
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+
+    def test_wrong_role_only_cluster_still_dispatches(self):
+        """Steering must never fail a dispatch unified routing would
+        serve: decode-preferred turns on an all-prefill cluster."""
+        engines, servers, urls = _serve_roled(["prefill", "prefill"])
+        try:
+            router = _disagg_router(urls)
+            m = Message(id="m1", content="hi", user_id="u",
+                        timeout=30.0)   # short → decode preference
+            router.process_fn(None, m)
+            assert m.metadata.get("endpoint_id")
+        finally:
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+
+    def test_unified_endpoints_serve_any_preference(self):
+        engines, servers, urls = _serve_roled(["unified"])
+        try:
+            router = _disagg_router(urls)
+            for i, content in enumerate(("z" * 200, "hi")):
+                m = Message(id=f"m{i}", content=content, user_id="u",
+                            timeout=30.0)
+                router.process_fn(None, m)
+                assert m.metadata.get("endpoint_id")
+        finally:
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+
+
+# -- plane-level exchange (payload fidelity across planes) ---------------------
+
+
+class TestPlaneExchange:
+    def test_cross_plane_payload_roundtrip(self):
+        store = InMemoryStore()
+        a = mk_plane("prefillA", store)
+        b = mk_plane("decodeB", store)
+        a.exchange = KVExchange(store, role="prefill", metrics=False)
+        b.exchange = KVExchange(store, role="decode", metrics=False)
+        try:
+            a.demote("c", [3, 5], list(range(16)), 16, None)
+            assert a.flush_jobs()
+            assert a.export_to_exchange("c")
+            assert a.flush_jobs()
+            assert b.prepare("c", remote=True)
+            status, entry = None, None
+
+            def claimed():
+                nonlocal status, entry
+                status, entry = b.claim("c")
+                return status == "ready"
+
+            assert wait_until(claimed)
+            assert entry.tokens == list(range(16))
+            leaves = b.unpack(entry)
+            assert np.all(np.asarray(leaves[0][:, 0]) == 3.0)
+            assert np.all(np.asarray(leaves[0][:, 1]) == 5.0)
+            assert entry.source_tier == "store"
+            b.release(entry)
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_exchange_miss_degrades_and_negative_caches(self):
+        store = InMemoryStore()
+        b = mk_plane("decodeB", store)
+        b.exchange = KVExchange(store, role="decode", metrics=False,
+                                miss_ttl_s=60.0)
+        try:
+            assert b.prepare("ghost", remote=True)
+            assert wait_until(lambda: b.claim("ghost")[0] == "none")
+            # Negative cache: the next remote prepare declines without
+            # creating a placeholder.
+            assert b.prepare("ghost", remote=True) is False
+        finally:
+            b.stop()
+
+    def test_local_prepare_never_touches_exchange(self):
+        store = InMemoryStore()
+        b = mk_plane("decodeB", store)
+        b.exchange = KVExchange(store, metrics=False)
+        try:
+            assert b.prepare("nothing-local", remote=False) is False
+            assert b.claim("nothing-local") == ("none", None)
+        finally:
+            b.stop()
+
+    def test_foreign_spec_refused_tokens_survive(self):
+        """A heterogeneous peer's page bytes are never injected: the
+        claimer keeps the token stream and recomputes."""
+        store = InMemoryStore()
+        b = mk_plane("decodeB", store)
+        b.exchange = KVExchange(store, role="decode", metrics=False)
+        pub = KVExchange(store, role="prefill", metrics=False)
+        foreign = [((4, 8, 16), np.dtype(np.int8))]
+        fbufs = [np.zeros(4 * 8 * 16, np.uint8) for _ in range(2)]
+        pub.publish("c", fbufs, foreign,
+                    {"conv_id": "c", "tokens": [9, 8, 7], "length": 3,
+                     "n_pages": 2})
+        try:
+            assert b.prepare("c", remote=True)
+            status, entry = None, None
+
+            def claimed():
+                nonlocal status, entry
+                status, entry = b.claim("c")
+                return status == "ready"
+
+            assert wait_until(claimed)
+            assert entry.payload is None
+            assert entry.tier == "recompute"
+            assert entry.tokens == [9, 8, 7]
+            b.release(entry)
+        finally:
+            b.stop()
+
+
+# -- conversation-level handoff (echo engines, full promote path) --------------
+
+
+def mk_disagg_engine(name, role, store, *, claim_ttl=120.0, now_fn=None,
+                     metrics=False):
+    eng = mk_echo_engine(name, tiering=KVTieringConfig(enabled=True),
+                         metrics=metrics)
+    sm = StateManager(ConversationConfig(cleanup_interval=0),
+                      store=store)
+    eng.attach_conversation_manager(sm)
+    xchg = KVExchange(store, role=role, claim_ttl_s=claim_ttl,
+                      metrics=metrics, now_fn=now_fn)
+    coord = DisaggCoordinator(
+        DisaggConfig(enabled=True, role=role, claim_ttl_s=claim_ttl),
+        eng, xchg)
+    return eng, sm, coord
+
+
+class TestConversationHandoff:
+    def test_prefill_publishes_decode_claims_zero_recompute(self):
+        store = InMemoryStore()
+        peng, psm, pcoord = mk_disagg_engine("prefill0", "prefill",
+                                             store)
+        deng, dsm, dcoord = mk_disagg_engine("decode0", "decode", store)
+        try:
+            psm.get_or_create("c", "u")
+            h1 = run_turn(peng, "t1", "the quick brown fox", "c")
+            # The finished turn's KV reaches the exchange (engine hook
+            # → demote → FIFO publish on the plane worker).
+            assert wait_until(
+                lambda: KVExchange.key_for("c") in store.list_kv())
+            # Follow-up lands on the DECODE replica which never served
+            # this conversation: remote prepare claims the exchange.
+            h2 = run_turn(deng, "t2", " jumps over", "c",
+                          history="the quick brown fox")
+            assert h2.result.kv_tier == "store"   # exchange hit
+            assert h2.result.cached_tokens > 0
+            st = deng.get_stats()["kv_tiering"]
+            assert st["hits"].get("recompute", 0) == 0
+            assert pcoord.exchange.totals["published"] == 1
+            assert dcoord.exchange.totals["claimed"] == 1
+            assert h1.result.text and h2.result.text
+        finally:
+            peng.stop()
+            deng.stop()
+            psm.stop()
+            dsm.stop()
+
+    def test_expired_claim_falls_back_to_recompute_identically(self):
+        """A dead prefill replica's publication ages out: the decode
+        side recomputes from history text — same tokens as a fresh
+        unified engine, never garbage KV, never a hang."""
+        base = mk_echo_engine("base0",
+                              tiering=KVTieringConfig(enabled=True))
+        want = run_turn(base, "tb", " jumps over", "c",
+                        history="the quick brown fox").result.tokens
+        base.stop()
+
+        now = FakeNow()
+        store = InMemoryStore()
+        peng, psm, _ = mk_disagg_engine("prefill0", "prefill", store,
+                                        claim_ttl=10.0, now_fn=now)
+        deng, dsm, dcoord = mk_disagg_engine("decode0", "decode", store,
+                                             claim_ttl=10.0, now_fn=now)
+        try:
+            psm.get_or_create("c", "u")
+            run_turn(peng, "t1", "the quick brown fox", "c")
+            assert wait_until(
+                lambda: KVExchange.key_for("c") in store.list_kv())
+            now.t += 11.0                      # publication expires
+            h2 = run_turn(deng, "t2", " jumps over", "c",
+                          history="the quick brown fox")
+            assert h2.result.tokens == want    # recompute, bit-equal
+            assert dcoord.exchange.totals["expired"] == 1
+        finally:
+            peng.stop()
+            deng.stop()
+            psm.stop()
+            dsm.stop()
+
+    def test_drain_publish_warm_migrates_conversations(self):
+        """Drain-time migration: ANY role's warm conversations go to
+        the exchange; a peer resumes them with a store hit."""
+        store = InMemoryStore()
+        aeng, asm, acoord = mk_disagg_engine("unified0", "unified",
+                                             store)
+        beng, bsm, _ = mk_disagg_engine("decode0", "decode", store)
+        try:
+            asm.get_or_create("warm", "u")
+            run_turn(aeng, "t1", "conversation to migrate", "warm")
+            # Unified role: nothing published on finish...
+            assert KVExchange.key_for("warm") not in store.list_kv()
+            # ...until the drain migration pushes the warm set.
+            assert acoord.publish_warm() == 1
+            assert acoord.plane.flush_jobs()
+            assert KVExchange.key_for("warm") in store.list_kv()
+            h2 = run_turn(beng, "t2", " resumed elsewhere", "warm",
+                          history="conversation to migrate")
+            assert h2.result.kv_tier == "store"
+            st = beng.get_stats()["kv_tiering"]
+            assert st["hits"].get("recompute", 0) == 0
+        finally:
+            aeng.stop()
+            beng.stop()
+            asm.stop()
+            bsm.stop()
+
+
+# -- replica restart rehydration -----------------------------------------------
+
+
+class TestRehydration:
+    def test_plane_rehydrate_owned_blobs_only(self):
+        store = InMemoryStore()
+        # host_capacity_mb=0: demotes spill straight to the store.
+        a = mk_plane("replica0", store,
+                     KVTieringConfig(enabled=True, host_capacity_mb=0))
+        a.demote("mine", [2, 4], list(range(16)), 16, None)
+        assert wait_until(lambda: a.counts().get("store", 0) == 1)
+        a.stop()
+        # A blob some OTHER replica owns, plus an exchange entry:
+        # neither may be adopted.
+        b = mk_plane("replica1", store,
+                     KVTieringConfig(enabled=True, host_capacity_mb=0))
+        b.demote("theirs", [6], list(range(8)), 8, None)
+        assert wait_until(lambda: b.counts().get("store", 0) == 1)
+        b.stop()
+        KVExchange(store, metrics=False).publish(
+            "xc", _bufs(1), SPECS, {"conv_id": "xc"})
+
+        restarted = mk_plane("replica0", store,
+                             KVTieringConfig(enabled=True,
+                                             host_capacity_mb=0))
+        try:
+            adopted = restarted.rehydrate(owner="replica0")
+            assert [cid for cid, _ in adopted] == ["mine"]
+            status, entry = restarted.claim("mine")
+            assert status == "ready" and entry.source_tier == "store"
+            leaves = restarted.unpack(entry)
+            assert np.all(np.asarray(leaves[0][:, 0]) == 2.0)
+            restarted.release(entry)
+        finally:
+            restarted.stop()
+
+    def test_rehydrate_registers_prefix_handles(self):
+        """Engine-level restart: rehydrate_tiered_conversations adopts
+        the blob AND re-registers the prefix handle (tier "store") on
+        a conversation faulted back from the same store."""
+        store = InMemoryStore()
+        a = mk_plane("restart0", store,
+                     KVTieringConfig(enabled=True, host_capacity_mb=0))
+        a.demote("c", [3], list(range(8)), 8, None)
+        assert wait_until(lambda: a.counts().get("store", 0) == 1)
+        a.stop()
+
+        eng = mk_echo_engine("restart0",
+                             tiering=KVTieringConfig(enabled=True))
+        sm = StateManager(ConversationConfig(cleanup_interval=0),
+                          store=store)
+        sm.get_or_create("c", "u")             # durable conversation
+        sm.stop()
+        # "Restart": fresh engine + state manager over the same store.
+        eng._tiering.stop()
+        eng._tiering = a.__class__(
+            KVTieringConfig(enabled=True, host_capacity_mb=0),
+            "restart0", FakeKVExec())
+        sm2 = StateManager(ConversationConfig(cleanup_interval=0),
+                           store=store)
+        eng.attach_conversation_manager(sm2)
+        try:
+            assert eng.rehydrate_tiered_conversations() == 1
+            h = sm2.prefix_handle("c")
+            assert h is not None and h["tier"] == "store"
+            assert h["length"] == 8 and h["pages"] == 1
+            cached, _ = eng.prefill_estimate("c", 4)
+            assert cached > 0                  # promotable again
+        finally:
+            eng.stop()
+            sm2.stop()
+
+    def test_build_disagg_rehydrates_on_start(self):
+        store = InMemoryStore()
+        a = mk_plane("boot0", store,
+                     KVTieringConfig(enabled=True, host_capacity_mb=0))
+        a.demote("c", [5], list(range(8)), 8, None)
+        assert wait_until(lambda: a.counts().get("store", 0) == 1)
+        a.stop()
+
+        cfg = default_config()
+        cfg.disagg = DisaggConfig(enabled=True, role="decode")
+        eng = mk_echo_engine("boot0",
+                             tiering=KVTieringConfig(enabled=True))
+        eng._tiering.stop()
+        eng._tiering = a.__class__(
+            KVTieringConfig(enabled=True, host_capacity_mb=0), "boot0",
+            FakeKVExec())
+        sm = StateManager(ConversationConfig(cleanup_interval=0),
+                          store=store)
+        sm.get_or_create("c", "u")
+        eng.attach_conversation_manager(sm)
+        try:
+            coord = build_disagg(cfg, eng, store)
+            assert coord is not None
+            assert eng._tiering.counts().get("store", 0) == 1
+            assert eng.disagg_role == "decode"
+        finally:
+            eng.stop()
+            sm.stop()
+
+
+# -- metrics -------------------------------------------------------------------
+
+
+class TestDisaggMetrics:
+    def test_label_contract_covers_disagg(self):
+        from llmq_tpu.metrics.registry import LABEL_CONTRACT
+        assert LABEL_CONTRACT["role"] == frozenset(
+            {"prefill", "decode", "unified"})
+        assert "handoff" in LABEL_CONTRACT["reason"]
+
+    def test_exchange_families_flushed_at_scrape(self):
+        from llmq_tpu.metrics.registry import exposition
+
+        store = InMemoryStore()
+        now = FakeNow()
+        pub = KVExchange(store, role="prefill", claim_ttl_s=10.0,
+                         metrics=True, now_fn=now)
+        sub = KVExchange(store, role="decode", claim_ttl_s=10.0,
+                         metrics=True, now_fn=now)
+        pub.publish("a", _bufs(1), SPECS, {"conv_id": "a"})
+        assert sub.claim("a") is not None
+        pub.publish("b", _bufs(1), SPECS, {"conv_id": "b"})
+        now.t += 11.0
+        assert sub.claim("b") is None          # expired
+        sub.note_fallback()
+        exp = exposition().decode()            # scrape-time flush
+        assert ('llm_queue_kv_exchange_published_total'
+                '{role="prefill"} 2') in exp
+        assert ('llm_queue_kv_exchange_claimed_total'
+                '{role="decode"} 1') in exp
+        assert ('llm_queue_kv_exchange_expired_total'
+                '{role="prefill"} 1') in exp   # publisher's role
+        assert ('llm_queue_kv_exchange_fallback_total'
+                '{role="decode"} 1') in exp
+        assert ('llm_queue_kv_handoff_ms_count'
+                '{role="decode"} 1') in exp
+        # Buffered counters drained; lifetime totals survive.
+        flush_metrics()
+        assert sub.totals["claimed"] == 1
+
+
+# -- role-aware control-plane scaling ------------------------------------------
+
+
+class TestRoleAwareScaling:
+    def test_new_replica_joins_underrepresented_side(self):
+        from llmq_tpu.cluster.router import ClusterRouter
+        from llmq_tpu.controlplane import (LocalEnginePool,
+                                           ReplicaController)
+        from llmq_tpu.core.config import ControlPlaneConfig
+
+        engines = []
+
+        def factory(seq):
+            eng = mk_echo_engine(f"pool{seq}")
+            eng.start()
+            engines.append(eng)
+            return eng
+
+        lb = LoadBalancer(LoadBalancerConfig(
+            strategy="round_robin", health_check_interval=0.0))
+        router = ClusterRouter(lb, config=ClusterConfig(),
+                               enable_metrics=False)
+        pool = LocalEnginePool(factory, supervise=False)
+        ctl = ReplicaController(
+            config=ControlPlaneConfig(enabled=True, interval=0),
+            router=router, pool=pool, enable_metrics=False)
+        ctl.disagg = DisaggConfig(enabled=True)
+        try:
+            # Empty set → decode first (ties go to decode)...
+            assert ctl._role_for_new_replica() == "decode"
+            assert ctl._provision_one()
+            ep0 = router.lb.endpoints()[0]
+            assert router._role_of(ep0) == "decode"
+            # ...then the under-represented prefill side.
+            assert ctl._role_for_new_replica() == "prefill"
+            assert ctl._provision_one()
+            roles = sorted(router._role_of(e)
+                           for e in router.lb.endpoints())
+            assert roles == ["decode", "prefill"]
+            # Disagg off → no role hint, no pinning.
+            ctl.disagg = None
+            assert ctl._role_for_new_replica() is None
+            assert ctl._provision_one()
+            assert pool.role_hint is None
+        finally:
+            pool.stop()
+            for e in engines:
+                if e.running:
+                    e.stop()
+
+
+# -- SIGKILL mid-handoff chaos (real OS processes, InvariantChecker) -----------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_health(url: str, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/health", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except OSError as e:
+            last = e
+        time.sleep(0.1)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+def _post(url: str, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"{url}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str, path: str) -> dict:
+    with urllib.request.urlopen(f"{url}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _counter(text: str, family: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(family) and " " in ln:
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def _spawn_replica(port: int, env: dict, role: str) -> subprocess.Popen:
+    e = dict(env)
+    e["LLMQ_DISAGG_ROLE"] = role
+    return subprocess.Popen(
+        [sys.executable, "-m", "llmq_tpu", "--backend", "echo",
+         "--host", "127.0.0.1", "--port", str(port), "serve"],
+        cwd=REPO, env=e, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+def test_prefill_sigkill_mid_handoff_zero_loss_zero_dup(tmp_path):
+    """The disagg acceptance chaos path over REAL OS processes: one
+    prefill + one decode replica sharing a sqlite KV store, a gateway
+    routing by role. Long first turns land on the prefill replica,
+    which publishes each finished turn's KV to the exchange; the
+    prefill replica is SIGKILLed mid-flood; every in-flight and
+    follow-up message still reaches exactly one completion
+    (InvariantChecker: zero loss, zero duplicates), follow-ups claim
+    the dead replica's published KV from the exchange, and the
+    gateway's stats expose the learned role map."""
+    from llmq_tpu.chaos.invariants import InvariantChecker
+
+    env = dict(os.environ)
+    env["LLMQ_QUEUE_ENABLE_METRICS"] = "true"
+    env["LLMQ_LOADBALANCER_STRATEGY"] = "round_robin"
+    env["LLMQ_LOADBALANCER_HEALTH_CHECK_INTERVAL"] = "0.5"
+    env["LLMQ_QUEUE_WORKER_PROCESS_INTERVAL"] = "0.01"
+    env["LLMQ_DISAGG_ENABLED"] = "true"
+    env["LLMQ_DISAGG_LONG_PROMPT_TOKENS"] = "32"
+    env["LLMQ_EXECUTOR_KV_TIERING_ENABLED"] = "true"
+    env["LLMQ_PERSISTENCE_BACKEND"] = "sqlite"
+    env["LLMQ_PERSISTENCE_SQLITE_PATH"] = str(tmp_path / "shared.db")
+
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    prefill = _spawn_replica(ports[0], env, "prefill")
+    decode = _spawn_replica(ports[1], env, "decode")
+    gw_port = _free_port()
+    gw = f"http://127.0.0.1:{gw_port}"
+    procs = [prefill, decode]
+    ck = InvariantChecker()
+    try:
+        for u in urls:
+            _wait_health(u)
+        assert _get(urls[0], "/health")["role"] == "prefill"
+        assert _get(urls[1], "/health")["role"] == "decode"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "llmq_tpu", "--host", "127.0.0.1",
+             "--port", str(gw_port),
+             "--peers", f"{urls[0]},{urls[1]}", "gateway"],
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        _wait_health(gw)
+
+        # Role routing engages once the gateway's health probes have
+        # carried the replicas' role advertisements home (the 0.5 s
+        # loop) — wait for the learned map before flooding.
+        def roles_learned():
+            st = _get(gw, "/api/v1/cluster/stats")
+            r = (st.get("disagg") or {}).get("roles") or {}
+            return set(r.values()) == {"prefill", "decode"}
+
+        assert wait_until(roles_learned, timeout=20.0, step=0.2), \
+            _get(gw, "/api/v1/cluster/stats")
+
+        def drain_all(mids, deadline_s=60.0):
+            deadline = time.time() + deadline_s
+            left = set(mids)
+            while left and time.time() < deadline:
+                for mid in list(left):
+                    m = _get(gw, f"/api/v1/messages/{mid}")
+                    if m["status"] == "completed" and m["response"]:
+                        ck.completed(mid)
+                        left.discard(mid)
+                    elif m["status"] == "failed":
+                        ck.failed(mid)
+                        left.discard(mid)
+                if left:
+                    time.sleep(0.05)
+            return left
+
+        # Phase 1: long first turns. Role routing steers every one of
+        # them to the prefill replica, which publishes the finished
+        # KV to the exchange as each completes.
+        convs, turn1 = [], []
+        for i in range(6):
+            conv = _post(gw, "/api/v1/conversations",
+                         {"user_id": "t"})["conversation_id"]
+            convs.append(conv)
+            mid = _post(gw, f"/api/v1/conversations/{conv}/messages",
+                        {"content": f"long prompt {i} " + "x" * 220,
+                         "user_id": "t"})["message_id"]
+            ck.submitted(mid)
+            turn1.append(mid)
+        assert drain_all(turn1) == set()
+        by_ep = {}
+        for mid in turn1:
+            ep = _get(gw, f"/api/v1/messages/{mid}"
+                      )["metadata"]["endpoint_id"]
+            by_ep[ep] = by_ep.get(ep, 0) + 1
+        roles = _get(gw, "/api/v1/cluster/stats")["disagg"]["roles"]
+        prefill_ep = next(e for e, r in roles.items() if r == "prefill")
+        assert by_ep == {prefill_ep: 6}        # role routing held
+        # The prefill side published its finished turns.
+        pre_metrics = _scrape(urls[0])
+        assert _counter(
+            pre_metrics,
+            'llm_queue_kv_exchange_published_total{role="prefill"}') >= 6
+
+        # Phase 2: SIGKILL the prefill replica MID-FLOOD — a second
+        # wave of long first turns is in flight when it dies.
+        wave2 = []
+        for i in range(4):
+            mid = _post(gw, "/api/v1/messages",
+                        {"content": f"wave2 {i} " + "y" * 220,
+                         "user_id": "t"})["message_id"]
+            ck.submitted(mid)
+            wave2.append(mid)
+        prefill.send_signal(signal.SIGKILL)
+        prefill.wait(timeout=10)
+
+        # Phase 3: follow-up turns for every conversation born on the
+        # now-dead replica. The decode replica claims the published KV
+        # from the exchange (the promote path IS the receive path);
+        # where the handoff cannot be served, history-text recompute
+        # answers — never a hang, never garbage KV.
+        turn2 = []
+        for conv in convs:
+            mid = _post(gw, f"/api/v1/conversations/{conv}/messages",
+                        {"content": "follow-up", "user_id": "t"}
+                        )["message_id"]
+            ck.submitted(mid)
+            turn2.append(mid)
+        assert drain_all(wave2) == set()
+        assert drain_all(turn2) == set()
+        ck.check()                              # zero loss, zero dup
+        for mid in turn2:
+            ep = _get(gw, f"/api/v1/messages/{mid}"
+                      )["metadata"]["endpoint_id"]
+            assert ep != prefill_ep             # dead replica avoided
+        dec_metrics = _scrape(urls[1])
+        claimed = _counter(
+            dec_metrics,
+            'llm_queue_kv_exchange_claimed_total{role="decode"}')
+        assert claimed >= 1                     # real cross-process
+        assert 'llm_queue_kv_handoff_ms_count' in dec_metrics
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

@@ -986,3 +986,105 @@ class TestTieringMetrics:
         assert ('llm_queue_kv_demote_ms_count{engine="tiertest"}'
                 ) in exp
         eng.stop()
+
+
+# -- cross-OS-process blob handoff over real HTTP (satellite) ------------------
+
+
+class TestCrossProcessBlobHandoff:
+    """The disagg exchange's transport-level contract: a blob encoded
+    in one OS process survives a REAL network hop and decodes in
+    another process bit-identically — including the int8 KV pages and
+    their float32 scale pool — and a blob torn in transit raises (the
+    importer degrades to recompute, never injects garbage)."""
+
+    def test_http_transfer_int8_scales_bit_identical(self):
+        import http.server
+        import os
+        import subprocess
+        import sys
+
+        rng = np.random.default_rng(33)
+        n_pages = 4
+        # An int8-quantized cache tree: quantized pages + their scale
+        # pool, riding as ordinary leaves with their own specs.
+        pages_i8 = rng.integers(-128, 128, (2, n_pages, 8, 16)
+                                ).astype(np.int8)
+        scales = rng.random((2, n_pages, 8)).astype(np.float32)
+        leaves = [pages_i8, scales]
+        specs = [((leaf.shape[0],) + leaf.shape[2:], leaf.dtype)
+                 for leaf in leaves]
+        per = page_payload_nbytes(specs)
+        bufs = [np.empty(per, np.uint8) for _ in range(n_pages)]
+        pack_pages(leaves, bufs)
+        blob = encode_blob(bufs, specs,
+                           meta={"conv_id": "c", "tokens": [1, 2, 3],
+                                 "length": 3, "n_pages": n_pages})
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                # /blob: the published entry; /torn: cut mid-payload,
+                # as a crashed publisher/partial write would leave it.
+                body = blob if self.path == "/blob" else blob[:-16]
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        port = srv.server_address[1]
+
+        child = f"""
+import urllib.request
+import numpy as np
+from llmq_tpu.tiering import decode_blob, unpack_pages
+from llmq_tpu.tiering.plane import blob_meta
+
+with urllib.request.urlopen(
+        "http://127.0.0.1:{port}/blob", timeout=10) as r:
+    blob = r.read()
+meta = blob_meta(blob)
+assert meta["tokens"] == [1, 2, 3], meta
+bufs, specs = decode_blob(blob)
+leaves = unpack_pages(bufs, specs)
+rng = np.random.default_rng(33)
+want_i8 = rng.integers(-128, 128, (2, {n_pages}, 8, 16)).astype(np.int8)
+want_sc = rng.random((2, {n_pages}, 8)).astype(np.float32)
+assert leaves[0].dtype == np.int8
+assert np.array_equal(leaves[0], want_i8)
+print("PAYLOAD_OK", flush=True)
+# Bit-identity of the scale pool: byte-level comparison, not almost-
+# equal — a single flipped mantissa bit would dequantize every value
+# in the page.
+assert leaves[1].dtype == np.float32
+assert np.array_equal(leaves[1].view(np.uint8), want_sc.view(np.uint8))
+print("SCALES_BIT_IDENTICAL", flush=True)
+with urllib.request.urlopen(
+        "http://127.0.0.1:{port}/torn", timeout=10) as r:
+    torn = r.read()
+try:
+    decode_blob(torn)
+except ValueError:
+    print("TORN_DEGRADES_TO_RECOMPUTE", flush=True)
+else:
+    raise AssertionError("torn blob decoded")
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", child],
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                env=env, capture_output=True, text=True, timeout=120)
+        finally:
+            srv.shutdown()
+        assert out.returncode == 0, out.stderr
+        assert "PAYLOAD_OK" in out.stdout
+        assert "SCALES_BIT_IDENTICAL" in out.stdout
+        assert "TORN_DEGRADES_TO_RECOMPUTE" in out.stdout
