@@ -637,6 +637,7 @@ def bench_controlplane_ramp(base_rate: float = 20.0,
         n_sent = 0
         replica_seconds = 0.0
         peak_live = 0
+        killed_at = None
         t_start = time.perf_counter()
         nxt = t_start
         last_sample = t_start
@@ -658,6 +659,24 @@ def bench_controlplane_ramp(base_rate: float = 20.0,
             last_sample = now
             rate = phase_rates[min(len(phase_rates) - 1,
                                    int(elapsed // phase_s))]
+            if (managed and killed_at is None
+                    and elapsed >= total_s * 0.5 and live > 1):
+                # Kill-and-replace leg: crash one pool replica mid-ramp
+                # so the controller's replace path runs under load —
+                # the replacement's boot decomposition (critical-path
+                # plane) then puts a number on what recovery_seconds
+                # was spent on.
+                victims = [e for e in lb.endpoints()
+                           if e.metadata.get("pool")]
+                if victims:
+                    victim = victims[0]
+                    veng = victim.metadata.get("engine")
+                    if veng is not None:
+                        veng.stop()
+                    victim.status = EndpointStatus.UNHEALTHY
+                    killed_at = now
+                    log(f"[controlplane] {name}: killed replica "
+                        f"{victim.id} at t={elapsed:.1f}s")
             if now < nxt:
                 time.sleep(min(0.002, nxt - now))
                 continue
@@ -725,6 +744,23 @@ def bench_controlplane_ramp(base_rate: float = 20.0,
         if ctl is not None:
             out["actions"] = dict(ctl.action_counts)
             out["scaled_down_clean"] = scaled_down_clean
+            # Recovery decomposition (critical-path plane): how long
+            # the kill→replaced-and-healthy window took and what the
+            # replacement's boot spent it on — compile share of
+            # recovery becomes a number, not a log line.
+            rec = ctl.snapshot().get("recovery") or {}
+            out["recovery"] = {
+                "killed": killed_at is not None,
+                "last_seconds": rec.get("last_seconds"),
+                "budget_seconds": rec.get("budget_seconds"),
+                "replacement_boot": rec.get("last_boot"),
+            }
+            boot = rec.get("last_boot") or {}
+            stages = boot.get("stages_s") or {}
+            total_boot = boot.get("total_s") or 0.0
+            if total_boot > 0:
+                out["recovery"]["compile_share"] = round(
+                    (stages.get("compile") or 0.0) / total_boot, 4)
         log(f"[controlplane] {name}: p99 "
             f"{out['realtime_p99_ms']:.1f}ms, "
             f"{out['replica_seconds']:.1f} replica-s, peak "
@@ -1724,6 +1760,12 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
         _led = get_usage_ledger()
         u0 = ((_led.snapshot(top_conversations=0).get("totals") or {})
               if _led.enabled else {})
+        # Critical-path snapshot for per-phase segment attribution
+        # (observability/critical_path.py — cumulative like the usage
+        # ledger, so the point reports deltas).
+        from llmq_tpu.observability.critical_path import get_critical_path
+        _cp_ana = get_critical_path()
+        cp0 = _cp_ana.snapshot(recent=0) if _cp_ana.enabled else None
         while time.perf_counter() - t_start < dur:
             now = time.perf_counter()
             if now < next_arrival:
@@ -1918,6 +1960,38 @@ def bench_poisson_tpu(model_name: str, rates, duration_s: float,
                 "goodput_tokens_per_device_s":
                     _led.goodput()["tokens_per_device_second"],
             }
+        # Per-phase critical-path attribution: segment-time deltas
+        # against the phase-start snapshot, and the segment that
+        # dominated the most requests this phase — the "where did the
+        # p99 go" number the curve headline cites.
+        if cp0 is not None:
+            try:
+                from llmq_tpu.observability.recorder import get_recorder
+                get_recorder().flush_metrics()
+            except Exception:  # noqa: BLE001 — attribution, not a gate
+                pass
+            cp1 = _cp_ana.snapshot(recent=0)
+            seg_ms = {
+                k: round(v - (cp0["totals_ms"].get(k) or 0.0), 3)
+                for k, v in cp1["totals_ms"].items()
+                if v - (cp0["totals_ms"].get(k) or 0.0) > 0.0005}
+            dom = {k: v - (cp0["dominant"].get(k) or 0)
+                   for k, v in cp1["dominant"].items()
+                   if v - (cp0["dominant"].get(k) or 0) > 0}
+            point["critical_path"] = {
+                "requests": cp1["requests"] - cp0["requests"],
+                "segments_ms": seg_ms,
+                "dominant_segment": (max(dom, key=dom.get)
+                                     if dom else None),
+                "dominant_counts": dom,
+                "conservation_failures": (
+                    cp1["conservation_failures"]
+                    - cp0["conservation_failures"]),
+            }
+            if dom:
+                log(f"  critical path: dominant="
+                    f"{point['critical_path']['dominant_segment']} "
+                    f"over {point['critical_path']['requests']} reqs")
         # The tunnel-free projection: the measured critical path carries
         # ~2 host↔device round-trips (prefill-sample fetch + chunk
         # fetch — see decomp first_sample/tail); on a real TPU VM the

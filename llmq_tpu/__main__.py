@@ -677,9 +677,20 @@ def _maybe_join_cluster() -> None:
 
 def cmd_serve(args) -> int:
     cfg = _load(args)
+    # Serve-boot decomposition (docs/observability.md "Critical path &
+    # boot telemetry"): open THIS process's boot record before the App
+    # builds the engine — the builder/executor stamp weights/compile/
+    # warmup into it, /health advertises it, and a parent ReplicaPool
+    # adopts it across the process seam. One no-op call when off.
+    import time as _time
+    from llmq_tpu.observability import critical_path as _cp
+    serve_id = f"serve:{cfg.server.host}:{cfg.server.port}"
+    t_boot0 = _time.perf_counter()
+    _cp.boot_begin(serve_id, "serve", process=True)
     app = App(cfg, with_api=True, with_workers=True, with_engine=True,
               with_scheduler=True)
     app.start()
+    _cp.boot_ready(serve_id, _time.perf_counter() - t_boot0)
     app.wait()
     app.shutdown()
     return 0

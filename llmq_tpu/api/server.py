@@ -257,6 +257,7 @@ class ApiServer:
         r("GET", f"{v1}/cluster/overview", self.get_cluster_overview)
         r("GET", f"{v1}/engine/stats", self.get_engine_stats)
         r("GET", f"{v1}/usage", self.get_usage)
+        r("GET", f"{v1}/analysis/critical-path", self.get_critical_path)
         r("GET", f"{v1}/tenancy", self.get_tenancy)
         r("POST", f"{v1}/generate", self.generate_sync)
         r("GET", f"{v1}/requests/:id/trace", self.get_request_trace)
@@ -438,6 +439,20 @@ class ApiServer:
             # here at all) — visible to probes and peers.
             out["controller"] = ("paused" if self.controller.paused
                                  else "running")
+        try:
+            # Boot decomposition advertisement (critical-path plane):
+            # a parent ReplicaPool adopts these stages across the
+            # process seam. Absent when the plane is off or no
+            # entrypoint opened a process boot record — pre-feature
+            # health bodies stay byte-identical.
+            from llmq_tpu.observability.critical_path import (
+                cp_enabled, process_boot_snapshot)
+            if cp_enabled():
+                boot = process_boot_snapshot()
+                if boot is not None:
+                    out["boot"] = boot
+        except Exception:  # noqa: BLE001 — health must never fail on telemetry
+            pass
         return 200, out
 
     def metrics_exposition(self, req: _Request) -> Tuple[int, Any]:
@@ -932,6 +947,45 @@ class ApiServer:
                 out["usage"] = led.snapshot(top_conversations=0)
         except Exception:  # noqa: BLE001 — stats must not fail on usage plane
             pass
+        try:
+            # Boot decomposition rides along too: the overview joins a
+            # replica's serving telemetry to what its boot cost.
+            from llmq_tpu.observability.critical_path import (
+                cp_enabled, process_boot_snapshot)
+            if cp_enabled():
+                boot = process_boot_snapshot()
+                if boot is not None:
+                    out["boot"] = boot
+        except Exception:  # noqa: BLE001 — stats must not fail on boot plane
+            pass
+        return 200, out
+
+    def get_critical_path(self, req: _Request) -> Tuple[int, Any]:
+        """Critical-path rollup (docs/observability.md "Critical path &
+        boot telemetry"): fleet-wide per-segment time totals/shares,
+        dominant-segment counts, recent decompositions, and every known
+        replica boot decomposition. ``?recent=N`` sizes the recent
+        list."""
+        from llmq_tpu.observability.critical_path import (
+            get_boot_registry, get_critical_path)
+        ana = get_critical_path()
+        if not ana.enabled:
+            raise ApiError(503, "critical-path plane disabled "
+                                "(set observability.critical_path"
+                                ".enabled)")
+        try:
+            # Drain the recorder's deferred feed first: the rollup must
+            # include every finished request even when nothing scrapes
+            # /metrics (same discipline as the SLO/usage surfaces).
+            observability.get_recorder().flush_metrics()
+        except Exception:  # noqa: BLE001 — rollup must not fail on trace plane
+            pass
+        try:
+            recent = int(req.q("recent") or 20)
+        except ValueError:
+            raise ApiError(400, "recent must be an integer")
+        out = ana.snapshot(recent=max(0, min(recent, 256)))
+        out["boot"] = get_boot_registry().snapshot()
         return 200, out
 
     def get_usage(self, req: _Request) -> Tuple[int, Any]:
@@ -1079,7 +1133,21 @@ class ApiServer:
                 spans = prof.snapshot()
             return 200, observability.chrome_trace(
                 [tl], spans=spans, jax_trace_dir=trace_dir())
-        return 200, tl.to_dict()
+        out = tl.to_dict()
+        try:
+            # Per-request critical-path decomposition rides the trace
+            # payload for finished requests (None mid-flight).
+            from llmq_tpu.observability.critical_path import (
+                cp_enabled, decompose)
+            if cp_enabled():
+                d = decompose(tl)
+                if d is not None:
+                    d["segments"] = {k: round(v, 6)
+                                     for k, v in d["segments"].items()}
+                    out["critical_path"] = d
+        except Exception:  # noqa: BLE001 — trace must not fail on cp plane
+            pass
+        return 200, out
 
     def get_flight_recorder(self, req: _Request) -> Tuple[int, Any]:
         """Flight-recorder state: ring stats, the most recent request

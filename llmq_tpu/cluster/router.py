@@ -605,6 +605,30 @@ class ClusterRouter(EngineRouter):
                         entry["slo"] = get_slo_tracker().snapshot()
                     except Exception:  # noqa: BLE001 — rollup survives
                         pass
+                if stats.get("boot") is not None:
+                    # Remote replicas attach their boot decomposition
+                    # to engine/stats (critical-path plane).
+                    entry["boot"] = stats["boot"]
+                elif remote is None:
+                    # LOCAL engines: this process's boot registry —
+                    # prefer the pool's record for this endpoint (the
+                    # pool stamped provision + ready), else the
+                    # process's own serve-boot record.
+                    try:
+                        from llmq_tpu.observability.critical_path import (
+                            cp_enabled, get_boot_registry,
+                            process_boot_snapshot)
+                        if cp_enabled():
+                            boot_id = (getattr(ep, "metadata", None)
+                                       or {}).get("boot_id")
+                            boot = (get_boot_registry().get(str(boot_id))
+                                    if boot_id else None)
+                            if boot is None:
+                                boot = process_boot_snapshot()
+                            if boot is not None:
+                                entry["boot"] = boot
+                    except Exception:  # noqa: BLE001 — rollup survives
+                        pass
             return entry
 
         if endpoints:
@@ -641,6 +665,7 @@ class ClusterRouter(EngineRouter):
             if occ is not None:
                 occupancies.append(occ)
         reporting = sum(1 for r in replicas if "device" in r)
+        boot_reporting = sum(1 for r in replicas if "boot" in r)
         return {
             "replicas": replicas,
             "aggregate": {
@@ -651,6 +676,7 @@ class ClusterRouter(EngineRouter):
                                  if mfus else 0.0),
                 "max_kv_pool_occupancy": (round(max(occupancies), 4)
                                           if occupancies else 0.0),
+                "boot_reporting": boot_reporting,
                 "usage": {
                     "reporting": usage_reporting,
                     "device_seconds": round(u_device, 6),

@@ -114,6 +114,10 @@ class ReplicaController:
         self._peak_replica_tok_s = 0.0
         self._recovering_since: Optional[float] = None
         self.last_recovery_s: Optional[float] = None
+        #: Boot-registry id of the most recently provisioned replica —
+        #: joins recovery_seconds to its boot decomposition (how much
+        #: of the recovery wall was compile vs weights vs provision).
+        self.last_boot_id: Optional[str] = None
         self.last_action: Optional[Dict[str, Any]] = None
         self.action_counts: Dict[str, int] = {}
         self.ticks = 0
@@ -501,6 +505,7 @@ class ReplicaController:
                               ep.id)
             return False
         ep.metadata.setdefault("pool", True)
+        self.last_boot_id = str(ep.metadata.get("boot_id") or ep.id)
         self.router.lb.add_endpoint(ep)
         if role is not None:
             # Pin the role in the router immediately: local-engine
@@ -626,6 +631,22 @@ class ReplicaController:
 
     # -- accounting ----------------------------------------------------------
 
+    def _last_boot_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Boot decomposition of the most recently provisioned replica
+        (critical-path plane) — answers "how much of recovery_seconds
+        was compile" without grepping logs. None when nothing was
+        provisioned yet or the plane is off."""
+        if self.last_boot_id is None:
+            return None
+        try:
+            from llmq_tpu.observability.critical_path import (
+                cp_enabled, get_boot_registry)
+            if not cp_enabled():
+                return None
+            return get_boot_registry().get(self.last_boot_id)
+        except Exception:  # noqa: BLE001 — snapshot must never raise
+            return None
+
     def _count(self, action: str, reason: str) -> None:
         with self._mu:
             key = f"{action}:{reason}"
@@ -681,6 +702,7 @@ class ReplicaController:
                 "in_progress": self._recovering_since is not None,
                 "last_seconds": self.last_recovery_s,
                 "budget_seconds": self.config.recovery_budget_s,
+                "last_boot": self._last_boot_snapshot(),
             },
             "ticks": self.ticks,
             "last_action": last,

@@ -49,11 +49,16 @@ from llmq_tpu.utils.logging import get_logger
 log = get_logger("controlplane.pool")
 
 
-def _wait_ready(url: str, timeout: float) -> bool:
+def _wait_ready(url: str, timeout: float) -> Optional[Dict[str, Any]]:
     """Poll ``{url}/health`` until it answers 200 (the provision
     contract: a returned endpoint is immediately dispatchable — an
     endpoint registered before its replica serves would trip breakers
-    and get itself declared dead while still booting)."""
+    and get itself declared dead while still booting).
+
+    Returns the parsed /health JSON body (``{}`` when unparseable) so
+    the pool can adopt the child's boot decomposition, or None on
+    timeout."""
+    import json
     import urllib.request
     deadline = time.monotonic() + timeout  # lint: allow-wallclock — replica readiness is real elapsed time
     while time.monotonic() < deadline:  # lint: allow-wallclock — see above
@@ -61,11 +66,34 @@ def _wait_ready(url: str, timeout: float) -> bool:
             with urllib.request.urlopen(f"{url}/health",
                                         timeout=1.0) as resp:
                 if resp.status == 200:
-                    return True
+                    try:
+                        body = json.loads(resp.read().decode("utf-8"))
+                    except Exception:  # noqa: BLE001 — health is up; body shape is best-effort
+                        body = {}
+                    return body if isinstance(body, dict) else {}
         except Exception:  # noqa: BLE001 — still coming up
             pass
         time.sleep(0.1)
-    return False
+    return None
+
+
+def _adopt_child_boot(replica_id: str, kind: str,
+                      health_body: Optional[Dict[str, Any]],
+                      total_s: float) -> None:
+    """Fold a child replica's /health ``boot`` block into this
+    process's boot registry (provision = ready wall minus the stages
+    the child stamped itself). No-op when the critical-path plane is
+    off or the child predates the boot block."""
+    from llmq_tpu.observability import critical_path as _cp
+    if not _cp.cp_enabled():
+        return
+    boot = (health_body or {}).get("boot") or {}
+    stages = boot.get("stages_s") or {}
+    try:
+        _cp.get_boot_registry().adopt(replica_id, kind, stages,
+                                      total_s=total_s)
+    except Exception:  # noqa: BLE001 — telemetry must not fail provision
+        log.exception("boot adoption failed for %s", replica_id)
 
 
 class ReplicaPool:
@@ -123,11 +151,29 @@ class LocalEnginePool(ReplicaPool):
         self.decommissioned = 0
 
     def provision(self, seq: int) -> Optional[Endpoint]:
+        from llmq_tpu.observability import critical_path as _cp
+        cp = _cp.cp_enabled()
+        boot_rid = f"local-{seq}"
+        t_boot0 = time.perf_counter()
+        if cp:
+            # Open the PROCESS boot record before the factory runs so
+            # the engine builder stamps weights/compile/warmup into it
+            # (and the engine stamps first_token later) instead of into
+            # a previously provisioned replica's record.
+            _cp.boot_begin(boot_rid, self.kind, process=True)
         engine = self._factory(seq)
         if engine is None:
             return None
         if not engine.running:
             engine.start()
+        if cp:
+            wall = time.perf_counter() - t_boot0
+            rec = _cp.get_boot_registry().get(boot_rid) or {}
+            known = sum(v for k, v in (rec.get("stages_s") or {}).items()
+                        if k != "provision")
+            _cp.boot_stage(boot_rid, "provision",
+                           max(0.0, wall - known))
+            _cp.boot_ready(boot_rid, wall)
         if self._supervise:
             from llmq_tpu.engine.supervisor import EngineSupervisor
             sup = EngineSupervisor(engine,
@@ -139,7 +185,8 @@ class LocalEnginePool(ReplicaPool):
         eid = engine.name
         ep = Endpoint(id=eid, name=eid, url=f"local://{eid}",
                       metadata={"engine": engine, "pool": True,
-                                "pool_seq": seq})
+                                "pool_seq": seq,
+                                "boot_id": boot_rid})
         with self._mu:
             self._engines[eid] = engine
             if sup is not None:
@@ -231,6 +278,7 @@ class SubprocessReplicaPool(ReplicaPool):
             # side this replica joins; the env override reaches the
             # child's DisaggConfig through _apply_env.
             env["LLMQ_DISAGG_ROLE"] = str(self.role_hint)
+        t_boot0 = time.perf_counter()
         try:
             proc = subprocess.Popen(cmd, env=env,
                                     stdout=subprocess.DEVNULL,
@@ -239,12 +287,15 @@ class SubprocessReplicaPool(ReplicaPool):
             log.exception("replica subprocess spawn failed (seq %d)",
                           seq)
             return None
-        if not _wait_ready(url, float(self.config.ready_timeout)):
+        health = _wait_ready(url, float(self.config.ready_timeout))
+        if health is None:
             log.error("replica %s never became ready; killing", url)
             proc.kill()
             proc.wait(timeout=5.0)
             return None
         eid = f"127.0.0.1:{port}"
+        _adopt_child_boot(eid, self.kind, health,
+                          time.perf_counter() - t_boot0)
         with self._mu:
             self._procs[eid] = proc
             self.provisioned += 1
@@ -307,6 +358,7 @@ class ExecReplicaPool(ReplicaPool):
     def provision(self, seq: int) -> Optional[Endpoint]:
         if not self.config.provision_cmd:
             return None
+        t_boot0 = time.perf_counter()
         env = dict(os.environ)
         env["LLMQ_REPLICA_SEQ"] = str(seq)
         if self.role_hint:
@@ -339,11 +391,14 @@ class ExecReplicaPool(ReplicaPool):
         # orchestrator's scale-up returns long before the pod/container
         # serves. Registering early would dispatch into a booting
         # replica, trip its breaker and get it declared dead mid-boot.
-        if not _wait_ready(url, float(self.config.ready_timeout)):
+        health = _wait_ready(url, float(self.config.ready_timeout))
+        if health is None:
             log.error("exec replica %s never became ready; running "
                       "decommission_cmd to roll back", url)
             self._run_decommission(seq, eid, url)
             return None
+        _adopt_child_boot(eid, self.kind, health,
+                          time.perf_counter() - t_boot0)
         with self._mu:
             self._urls[eid] = url
             self._seqs[eid] = seq

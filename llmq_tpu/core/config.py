@@ -372,6 +372,27 @@ class UsageConfig:
 
 
 @dataclass
+class CriticalPathConfig:
+    """Critical-path plane: per-request latency attribution and
+    replica-boot decomposition (observability/critical_path.py,
+    docs/observability.md "Critical path & boot telemetry").
+    ``enabled: false`` is a hard off-switch: no extra marks are
+    stamped, the scrape-time join is skipped, and behavior is
+    byte-identical to pre-feature code. FED by the flight recorder's
+    metrics flush: requires ``observability.enabled`` and
+    ``emit_metrics`` — with either off the analyzer is force-disabled
+    (and a warning logged) rather than reporting empty rollups with
+    no feed."""
+    enabled: bool = True
+    #: Finished per-request decompositions kept for the
+    #: ``GET /api/v1/analysis/critical-path`` recent sample list.
+    recent_capacity: int = 256
+    #: Replica boot records kept in the boot registry (LRU by
+    #: replica id) for /health, cluster overview and recovery joins.
+    boot_capacity: int = 64
+
+
+@dataclass
 class ObservabilityConfig:
     """Request-lifecycle trace plane (llmq_tpu/observability/,
     docs/observability.md). ``enabled: false`` is a hard off-switch:
@@ -398,6 +419,10 @@ class ObservabilityConfig:
     #: Usage plane: attribution ledger, goodput, waste decomposition
     #: (observability/usage.py).
     usage: UsageConfig = field(default_factory=UsageConfig)
+    #: Critical-path plane: per-request segment decomposition + replica
+    #: boot telemetry (observability/critical_path.py).
+    critical_path: CriticalPathConfig = field(
+        default_factory=CriticalPathConfig)
 
 
 @dataclass

@@ -28,6 +28,16 @@ def build_engine(cfg: Config, *, name: str = "engine0",
     the decode program up front when ``warmup``.
     """
     ex = cfg.executor
+    # Boot decomposition (observability/critical_path.py, ROADMAP item
+    # 3's measurement half): stamp weight-load / artifact / compile /
+    # warmup stages onto the process boot record — opened here when no
+    # entrypoint (serve boot, a replica pool) opened one first. One
+    # no-op call when the critical-path plane is off.
+    from llmq_tpu.observability import critical_path as _cp
+    boot_id = _cp.current_boot_id()
+    if boot_id is None and _cp.cp_enabled():
+        _cp.boot_begin(name, "engine", process=True)
+        boot_id = _cp.current_boot_id()
     tokenizer = get_tokenizer(getattr(cfg.model, "tokenizer_path", ""))
     metrics_on = cfg.metrics.enabled if enable_metrics is None else enable_metrics
 
@@ -122,6 +132,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         if kv_quant not in ("", "int8"):
             raise ValueError(f"unknown model.kv_quantization {kv_quant!r} "
                              f"(supported: 'int8')")
+        import time as _time
+        t_weights0 = _time.perf_counter()
         if params is None:
             path = cfg.model.checkpoint_path
             if path and path.endswith(".safetensors.d"):
@@ -150,6 +162,11 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             # that requires the checkpoint itself to be loaded shard-wise
             # on a host with enough RAM (checkpoint.py loads to host).
             params = quantize_params(params)
+        if boot_id is not None:
+            # Checkpoint load / random init / quantization — the
+            # "weights" boot stage.
+            _cp.boot_stage(boot_id, "weights",
+                           _time.perf_counter() - t_weights0)
         mesh = None
         if mesh_shape:
             # Sharded serving (BASELINE config #5, docs/multihost.md
@@ -186,6 +203,13 @@ def build_engine(cfg: Config, *, name: str = "engine0",
             telemetry_metrics=metrics_on)
         if warmup:
             executor.warmup()
+            if boot_id is not None:
+                # The executor decomposed its own warmup wall:
+                # artifact (export-cache loads) vs compile (trace +
+                # lower) vs warmup (smoke + step calibration).
+                for stg, secs in getattr(executor, "warmup_split",
+                                         {}).items():
+                    _cp.boot_stage(boot_id, stg, secs)
     else:
         raise ValueError(f"unknown executor backend {ex.backend!r}")
 

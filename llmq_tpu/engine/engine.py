@@ -57,6 +57,8 @@ from llmq_tpu.engine.executor import Executor, HostStaging
 from llmq_tpu.engine.kv_allocator import PageAllocator
 from llmq_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from llmq_tpu.metrics.registry import get_metrics
+from llmq_tpu.observability.critical_path import (
+    get_critical_path, note_first_token as boot_note_first_token)
 from llmq_tpu.observability.device import get_device_telemetry
 from llmq_tpu.observability.usage import (DEFAULT_TENANT, RequestUsage,
                                           get_usage_ledger,
@@ -245,7 +247,7 @@ class _Sequence:
                  "todo_ids", "todo_pos", "todo_rebuild", "todo_resume",
                  "first_handle", "eff_prio", "arrival", "prefix_match",
                  "reuse_counted", "mixed_pending", "pf_tokens_run",
-                 "usage", "pending_emit", "served_tier")
+                 "usage", "pending_emit", "served_tier", "cp_decode_s")
 
     def __init__(self, req: GenRequest, handle: GenHandle, order: int,
                  max_pages: int) -> None:
@@ -318,6 +320,12 @@ class _Sequence:
         #: KV tier that served this re-arrival (tiering plane only;
         #: "" otherwise) — lands on GenResult.kv_tier.
         self.served_tier = ""
+        #: Critical-path plane: device+readback seconds attributed to
+        #: this sequence's DECODE rows (pro-rata chunk shares, same
+        #: weighting as the usage charge). Splits the decode span into
+        #: decode_compute vs decode_stall at decomposition time. Stays
+        #: 0.0 with the plane disabled.
+        self.cp_decode_s = 0.0
 
     def sort_key(self):
         return (self.eff_prio, self.order)
@@ -514,6 +522,11 @@ class InferenceEngine:
         #: with ``observability.usage.enabled`` false every charge
         #: point below reduces to one attribute check.
         self._usage = get_usage_ledger()
+        #: Critical-path plane (observability/critical_path.py): with
+        #: ``observability.critical_path.enabled`` false every extra
+        #: mark/accumulation site below reduces to one attribute check
+        #: — byte-identical to pre-feature behavior.
+        self._cp = get_critical_path()
         #: Tenancy plane (llmq_tpu/tenancy/, docs/tenancy.md): decode
         #: fairness past the queue — under multi-tenant contention the
         #: chunk's decode-row token budget and the mixed batcher's
@@ -1635,8 +1648,17 @@ class InferenceEngine:
           what the cached KV held (no reliance on ``history_text``).
         """
         plane = self._tiering
+        cp = self._cp.enabled
+        t_claim = time.perf_counter() if cp else 0.0
         status, entry = plane.claim(conv)
         if status != "ready":
+            if cp and status == "wait":
+                # Private mark (never emitted as a stage itself): the
+                # FIRST admission attempt that had to wait opens the
+                # promote/claim span; _stamp_promote renames it once
+                # the serving entry reveals whether this was a local
+                # tier promote or a disagg exchange claim.
+                seq.handle.marks.setdefault("_promote_wait", t_claim)
             return status
         t0 = time.perf_counter()
         restorable = (entry.length > 0
@@ -1682,6 +1704,8 @@ class InferenceEngine:
                                 (time.perf_counter() - t0) * 1e3)
             plane.release(entry)
             seq.served_tier = entry.source_tier
+            if cp:
+                self._stamp_promote(seq, entry, t_claim)
             self._note_tier(conv, "hbm")
             self._flush_tier_notes()
             return "done"
@@ -1705,9 +1729,27 @@ class InferenceEngine:
         plane.note_promoted(entry, "recompute",
                             (time.perf_counter() - t0) * 1e3)
         seq.served_tier = "recompute"
+        if cp:
+            self._stamp_promote(seq, entry, t_claim)
         self._note_tier(conv, "dropped")
         self._flush_tier_notes()
         return "done"
+
+    @staticmethod
+    def _stamp_promote(seq: _Sequence, entry, t_claim: float) -> None:
+        """Close the tiering-wait span on the handle marks: named
+        ``handoff_claim`` when the entry materialized from the disagg
+        exchange (a cross-replica prefill→decode handoff), else
+        ``kv_promote`` (local tier hierarchy / recompute fallback).
+        The span opens at the first waiting admission attempt
+        (``_promote_wait``) or this claim call, whichever came first."""
+        marks = seq.handle.marks
+        name = ("handoff_claim"
+                if getattr(entry, "from_exchange", False)
+                else "kv_promote")
+        marks.setdefault(f"{name}_start",
+                         marks.pop("_promote_wait", t_claim))
+        marks.setdefault(f"{name}_done", time.perf_counter())
 
     def _start_sequence(self, seq: _Sequence, slot: int) -> bool:
         """Admit ``seq`` into ``slot``. Returns False only when pages are
@@ -2595,6 +2637,25 @@ class InferenceEngine:
 
     # -- usage attribution (observability/usage.py) ---------------------------
 
+    def _cp_decode_share(self, chunk_s: float, parts,
+                         decode_rows) -> None:
+        """Decode rows' pro-rata share of one chunk's serial device
+        cost accumulates into ``cp_decode_s`` — the critical-path
+        decode compute/stall split reads it off the terminal trace
+        event (observability/critical_path.py). ``parts`` is the full
+        ``[(seq, weight, waste)]`` list the chunk ran (prefill slices
+        included, so shares stay overlap-truthful); ``decode_rows`` is
+        the ``[(seq, weight)]`` subset actually decoding."""
+        if chunk_s <= 0:
+            return
+        total_w = 0
+        for _, w, _ in parts:
+            total_w += w
+        if total_w <= 0:
+            return
+        for seq, w in decode_rows:
+            seq.cp_decode_s += chunk_s * (w / total_w)
+
     def _charge_step(self, device_s: float, parts) -> None:
         """Split one measured chunk's device-execute seconds pro-rata
         across the rows/slices that rode it. ``parts`` is
@@ -2674,20 +2735,28 @@ class InferenceEngine:
         pf_first = None
         if infl.pf is not None:
             out, pf_first = out      # mixed chunk: (decode, slice firsts)
-        if self._usage.enabled:
+        if self._usage.enabled or self._cp.enabled:
             # Attribute BEFORE committing: rows that finish during the
             # commit loop (EOS) finalize their ledger record there and
             # must already carry this chunk's share.
             parts = []
+            decode_rows = []
             for slot in range(self.spec.batch_size):
                 seq = infl.seqs[slot]
                 if seq is not None and seq.slot == slot:
-                    parts.append((seq, max(1, int(infl.budgets[slot])),
-                                  False))
+                    w = max(1, int(infl.budgets[slot]))
+                    parts.append((seq, w, False))
+                    decode_rows.append((seq, w))
             if infl.pf is not None:
                 for seq, n_tok, _final in infl.pf:
                     parts.append((seq, n_tok, seq.todo_rebuild))
-            self._charge_step(device_s, parts)
+            if self._usage.enabled:
+                self._charge_step(device_s, parts)
+            if self._cp.enabled:
+                # Serial cost = novel device time + readback —
+                # overlapped spans are already excluded by timed_fetch.
+                self._cp_decode_share(device_s + readback_s, parts,
+                                      decode_rows)
         tok0 = self.tokens_generated_total
         for slot in range(self.spec.batch_size):
             seq = infl.seqs[slot]
@@ -2873,11 +2942,15 @@ class InferenceEngine:
         self.steps += 1
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
-        if self._usage.enabled:
-            self._charge_step(t_done - t_call,
-                              [(seq, max(1, int(budgets[seq.slot])),
-                                False)
-                               for seq in active if seq.slot is not None])
+        if self._usage.enabled or self._cp.enabled:
+            parts = [(seq, max(1, int(budgets[seq.slot])), False)
+                     for seq in active if seq.slot is not None]
+            if self._usage.enabled:
+                self._charge_step(t_done - t_call, parts)
+            if self._cp.enabled:
+                self._cp_decode_share(
+                    (t_done - t_call) + (t_rb - t_done), parts,
+                    [(seq, w) for seq, w, _ in parts])
         tok0 = self.tokens_generated_total
         for seq in active:
             self._commit_row(seq, out[seq.slot], int(budgets[seq.slot]))
@@ -3050,12 +3123,17 @@ class InferenceEngine:
         self.mixed_prefill_tokens_total += packed
         if self._metrics:
             self._metrics.decode_steps.labels(self.name).inc()
-        if self._usage.enabled:
-            parts = [(seq, max(1, int(budgets[seq.slot])), False)
-                     for seq in active if seq.slot is not None]
-            parts.extend((seq, n_tok, seq.todo_rebuild)
-                         for seq, n_tok, _final in infl_pf)
-            self._charge_step(t_done - t0, parts)
+        if self._usage.enabled or self._cp.enabled:
+            decode_parts = [(seq, max(1, int(budgets[seq.slot])), False)
+                            for seq in active if seq.slot is not None]
+            parts = decode_parts + [(seq, n_tok, seq.todo_rebuild)
+                                    for seq, n_tok, _final in infl_pf]
+            if self._usage.enabled:
+                self._charge_step(t_done - t0, parts)
+            if self._cp.enabled:
+                self._cp_decode_share(
+                    (t_done - t0) + (t_rb - t_done), parts,
+                    [(seq, w) for seq, w, _ in decode_parts])
         tok0 = self.tokens_generated_total
         for seq in active:
             if seq.slot is not None:
@@ -3094,6 +3172,11 @@ class InferenceEngine:
         handle = seq.handle
         if len(seq.generated) == 1:
             handle.marks.setdefault("first_token", time.perf_counter())
+            if self._cp.enabled:
+                # Boot telemetry: the process's first committed token
+                # EVER closes the replica_ready_seconds decomposition
+                # (idempotent — one flag check after it fires).
+                boot_note_first_token()
         if handle._on_token is not None:
             if self._completion_workers > 0:
                 # Async pipeline: SSE framing/streaming callbacks run
@@ -3116,6 +3199,13 @@ class InferenceEngine:
             self._finish_active(seq, "length")
 
     def _finish_active(self, seq: _Sequence, reason: str) -> None:
+        if self._cp.enabled and seq.generated:
+            # Critical path: decode ends HERE — everything after (page
+            # trim, prefix publish, pin, exchange publish, detok +
+            # handle finish on the completion pool) is the
+            # "completion" segment.
+            seq.handle.marks.setdefault("decode_done",
+                                        time.perf_counter())
         if seq.slot is not None:
             self.executor.release_slot(seq.slot)
             self._slots[seq.slot] = None
@@ -3211,6 +3301,16 @@ class InferenceEngine:
             # which takes the lock itself).
             try:
                 self.on_conversation_cached(conv)
+                if self._cp.enabled:
+                    # Stage event (not a mark: the publish is wall-time
+                    # NOW, no perf anchor needed) — the stitched
+                    # ?format=chrome timeline shows where the disagg
+                    # handoff left this replica.
+                    from llmq_tpu import observability
+                    observability.record(
+                        seq.req.id, "kv_publish", engine=self.name,
+                        priority=seq.req.priority.tier_name,
+                        conversation=conv, role=self.disagg_role)
             except Exception:  # noqa: BLE001 — publish is best-effort
                 log.exception("on_conversation_cached failed for %s",
                               conv)
@@ -3231,8 +3331,11 @@ class InferenceEngine:
         marks = seq.handle.marks
         events = [(stage, marks[stage] + anchor,
                    {"engine": self.name, "priority": prio})
-                  for stage in ("admitted", "prefill_start",
-                                "prefill_done", "first_token")
+                  for stage in ("admitted", "kv_promote_start",
+                                "kv_promote_done", "handoff_claim_start",
+                                "handoff_claim_done", "prefill_start",
+                                "prefill_done", "first_token",
+                                "decode_done")
                   if stage in marks]
         # Cancellation (client closed the stream / gave up) is its own
         # terminal: neither a success nor a failure the flight recorder
@@ -3246,6 +3349,12 @@ class InferenceEngine:
                 "prompt_tokens": len(seq.prompt_ids),
                 "cached_tokens": seq.cached_len,
                 "tenant": seq.req.tenant_id}
+        if self._cp.enabled and seq.cp_decode_s > 0:
+            # Decode-span attribution for the critical-path split
+            # (decode_compute vs decode_stall) — carried on the
+            # terminal event so the scrape-time join needs no engine
+            # reference.
+            meta["decode_device_s"] = round(seq.cp_decode_s, 6)
         if seq.handle.usage is not None:
             # Cost next to latency: the trace/flight-recorder surfaces
             # show this request's attributed usage.

@@ -1046,6 +1046,14 @@ class JaxExecutor:
         self._hbm_static: Optional[Dict[int, Dict[str, int]]] = None
         self._warm_mu = threading.Lock()
         self._warm_done = 0
+        self._warm_hit_s = 0.0
+        self._warm_miss_s = 0.0
+        #: Boot decomposition of the last warmup() (critical_path.py):
+        #: {"artifact": s, "compile": s, "warmup": s} — export-cache
+        #: loads vs trace+lower+compile (AOT wall pro-rated by the
+        #: per-program hit/miss seconds, since programs compile in
+        #: parallel) vs the smoke/calibration remainder.
+        self.warmup_split: Dict[str, float] = {}
         #: Reusable host staging buffers per (program, geometry): the
         #: per-dispatch np.zeros churn killer. Decode/mixed tags are
         #: bounded by the pipeline depth (≤ 4); prefill tags are NOT
@@ -1410,11 +1418,19 @@ class JaxExecutor:
             # "Device telemetry"): per-program compile seconds +
             # hit/miss counters + the warmup-progress gauge, so the
             # geometry grid's compile cost is attributable per program.
-            self._telemetry.note_compile(name, time.perf_counter() - t0,
-                                         cache_hit)
+            dt = time.perf_counter() - t0
+            self._telemetry.note_compile(name, dt, cache_hit)
             with self._warm_mu:
                 self._warm_done += 1
                 done = self._warm_done
+                # Boot decomposition (critical_path.py): hit vs miss
+                # per-program seconds pro-rate the AOT wall into the
+                # "artifact" (export-cache load) vs "compile" (trace +
+                # lower + compile) boot stages.
+                if cache_hit:
+                    self._warm_hit_s += dt
+                else:
+                    self._warm_miss_s += dt
             self._telemetry.note_warmup(done, len(jobs))
 
         def compile_one(job):
@@ -1465,6 +1481,8 @@ class JaxExecutor:
 
         with self._warm_mu:
             self._warm_done = 0
+            self._warm_hit_s = 0.0
+            self._warm_miss_s = 0.0
         self._telemetry.note_warmup(0, len(jobs))
         with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
             for name in pool.map(compile_one, jobs):
@@ -1490,6 +1508,22 @@ class JaxExecutor:
             # execution pass below compiles everything anyway.
             log.exception("parallel AOT warmup failed; falling back")
             self._aot.clear()
+        # Boot decomposition: split the AOT wall between "artifact"
+        # (export-cache deserialize) and "compile" (trace + lower +
+        # compile) pro-rata on the per-program hit/miss seconds — the
+        # programs compile in parallel, so per-program sums exceed the
+        # wall and only the ratio is trustworthy.
+        aot_wall = time.perf_counter() - t_warm0
+        with self._warm_mu:
+            hit_s, miss_s = self._warm_hit_s, self._warm_miss_s
+        self.warmup_split = {}
+        if hit_s + miss_s > 0:
+            self.warmup_split["artifact"] = aot_wall * (
+                hit_s / (hit_s + miss_s))
+            self.warmup_split["compile"] = aot_wall * (
+                miss_s / (hit_s + miss_s))
+        elif aot_wall > 0:
+            self.warmup_split["compile"] = aot_wall
         spec = self.spec
         cache_warm = bool(self._aot) and all(
             name in self._from_export_cache for name in self._aot)
@@ -1571,8 +1605,11 @@ class JaxExecutor:
                 self.step_ms = None
                 log.warning("decode step timing unusable (EOS latched "
                             "every chunk); admission cap falls back")
-        self._telemetry.note_warmup_complete(
-            time.perf_counter() - t_warm0)
+        total_warm = time.perf_counter() - t_warm0
+        # The smoke executions + step calibration above are the
+        # "warmup" boot stage proper.
+        self.warmup_split["warmup"] = max(0.0, total_warm - aot_wall)
+        self._telemetry.note_warmup_complete(total_warm)
         try:
             # The serving-path RTT floor (previously bench-only): live
             # on /metrics so tail-latency numbers are interpretable

@@ -50,6 +50,14 @@ _STEP_MS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
 #: Program compiles: sub-second export-cache loads up to multi-minute
 #: cold Mosaic lowerings (303 s observed in BENCH_r03).
 _COMPILE_BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+#: Critical-path segments in MILLISECONDS: sub-ms completion-pool lag
+#: on echo up to multi-minute queue waits under saturation.
+_CP_MS_BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                  1000, 2500, 5000, 10000, 30000, 60000, 300000)
+#: Replica boot stages: sub-100 ms echo factory calls up to the
+#: multi-minute cold Mosaic compile (same ceiling as _COMPILE_BUCKETS).
+_BOOT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120,
+                 300, 600)
 
 #: Metrics-cardinality contract (tests/test_metrics_cardinality.py):
 #: EVERY label any family in this registry uses must appear here.
@@ -119,6 +127,17 @@ LABEL_CONTRACT = {
                        "latency", "crash"}),
     "code": frozenset({"429", "503", "500"}),
     "slo": frozenset({"ttft", "realtime"}),
+    # Critical-path plane (observability/critical_path.py): the
+    # exhaustive per-request segment decomposition. Closed enum —
+    # mirrors critical_path.SEGMENTS.
+    "segment": frozenset({"queue_wait", "dispatch", "admission",
+                          "kv_promote", "handoff_claim", "prefill",
+                          "decode_compute", "decode_stall",
+                          "completion"}),
+    # Replica boot decomposition (critical_path.BOOT_STAGES) on
+    # llm_queue_replica_ready_seconds.
+    "stage": frozenset({"provision", "artifact", "weights", "compile",
+                        "warmup", "first_token"}),
 }
 
 
@@ -568,6 +587,28 @@ class QueueMetrics:
             "1 while an operator has paused the controller "
             "(distinct from controlplane.enabled=false)",
             registry=registry)
+        # Critical-path plane (observability/critical_path.py,
+        # docs/observability.md "Critical path & boot telemetry"):
+        # per-request latency attribution + replica boot decomposition.
+        # Both fed at scrape time (recorder flush / boot-registry
+        # flush) — nothing here touches the request hot path.
+        self.critical_path_ms = Histogram(
+            f"{ns}_critical_path_ms",
+            "Per-request end-to-end latency attributed to one "
+            "critical-path segment (segments conserve: they sum to "
+            "the recorded e2e per request)", ["segment", "priority"],
+            buckets=_CP_MS_BUCKETS, registry=registry)
+        self.critical_path_dominant = Counter(
+            f"{ns}_critical_path_dominant_total",
+            "Requests whose largest critical-path segment was this "
+            "one — the fleet-wide 'where does time go' headline",
+            ["segment", "priority"], registry=registry)
+        self.replica_ready_seconds = Histogram(
+            f"{ns}_replica_ready_seconds",
+            "Replica boot decomposition: seconds per boot stage "
+            "(provision → artifact → weights → compile → warmup → "
+            "first_token) across all ReplicaPool kinds + serve boot",
+            ["stage"], buckets=_BOOT_BUCKETS, registry=registry)
         # SLO layer (llmq_tpu/observability/slo.py): burn rate 1.0 =
         # spending exactly the allowed error budget over the window.
         self.slo_burn_rate = Gauge(
@@ -632,6 +673,13 @@ def exposition() -> bytes:
         # handoff-latency observations (docs/disaggregation.md).
         from llmq_tpu.disagg import flush_metrics as disagg_flush
         disagg_flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Critical-path plane: buffered replica-boot stage observations
+        # (the per-request segment join rides the recorder flush above).
+        from llmq_tpu.observability.critical_path import flush_boot_metrics
+        flush_boot_metrics()
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -18,7 +18,11 @@ package makes one request legible across all of them:
   burn rates, fed from the recorder's finalized timelines;
 - :mod:`usage` — the attribution ledger: per-request device-seconds
   and KV page-seconds, per-tenant rollups, waste decomposition and the
-  rolling goodput gauge.
+  rolling goodput gauge;
+- :mod:`critical_path` — the per-request critical-path decomposition
+  (conservation-checked segments joining stage events, device
+  attribution, tiering/disagg waits and completion lag) plus the
+  ``replica_ready_seconds{stage}`` boot decomposition.
 
 The usage contract for instrumented layers is one line:
 
@@ -29,6 +33,21 @@ which no-ops fast when ``observability.enabled`` is false.
 """
 
 from llmq_tpu.observability.chrome import chrome_trace, perf_anchor  # noqa: F401
+from llmq_tpu.observability.critical_path import (  # noqa: F401
+    BOOT_STAGES,
+    SEGMENTS,
+    BootRegistry,
+    CriticalPathAnalyzer,
+    boot_begin,
+    boot_ready,
+    boot_stage,
+    configure_critical_path,
+    cp_enabled,
+    decompose,
+    get_boot_registry,
+    get_critical_path,
+    process_boot_snapshot,
+)
 from llmq_tpu.observability.device import (  # noqa: F401
     DeviceTelemetry,
     ProfileInProgress,

@@ -57,7 +57,10 @@ TERMINAL_STAGES = ("completed", "failed", "cancelled")
 #: Canonical stage order — used only for display sorting of events that
 #: share a timestamp; recording is order-free.
 STAGE_ORDER = ("enqueued", "received", "scheduled", "dispatched",
-               "admitted", "prefill_start", "prefill_done", "first_token",
+               "admitted", "kv_promote_start", "handoff_claim_start",
+               "kv_promote_done", "handoff_claim_done",
+               "prefill_start", "prefill_done", "first_token",
+               "kv_publish", "decode_done",
                "failover", "retry_scheduled", "completed", "failed",
                "cancelled")
 _STAGE_RANK = {s: i for i, s in enumerate(STAGE_ORDER)}
@@ -65,6 +68,25 @@ _STAGE_RANK = {s: i for i, s in enumerate(STAGE_ORDER)}
 
 def _host_tag() -> str:
     return f"{socket.gethostname()}:{os.getpid()}"
+
+
+_CP_ANALYZER = None
+
+
+def _cp_analyzer():
+    """Cached critical-path analyzer reference (lazy — critical_path
+    imports Timeline from THIS module, so the import must not run at
+    module load). One global read + one attribute check on the
+    finalize path once warmed."""
+    global _CP_ANALYZER
+    if _CP_ANALYZER is None:
+        try:
+            from llmq_tpu.observability.critical_path import \
+                get_critical_path
+            _CP_ANALYZER = get_critical_path()
+        except Exception:  # noqa: BLE001 — trace plane must not fail
+            return None
+    return _CP_ANALYZER
 
 
 class TraceEvent:
@@ -321,9 +343,23 @@ class FlightRecorder:
                     if tl.breached:
                         self.sla_breaches += 1
                     # Failures (not cancellations) are always retained.
-                    if tl.breached or evt.stage == "failed":
-                        self._slow.append(tl._copy())
+                    keep: Optional[Timeline] = None
+                    retained = tl.breached or evt.stage == "failed"
+                    if retained:
+                        keep = tl._copy()
+                        self._slow.append(keep)
                     if self.emit_metrics:
+                        # The critical-path join needs the FULL
+                        # timeline at scrape time; for retained
+                        # timelines the carried copy doubles as the
+                        # retention fix — the ring AND the bounded
+                        # slow buffer can both churn past this request
+                        # before the scrape drains its tuple
+                        # (flush_metrics re-retains from the carry).
+                        if keep is None:
+                            cp = _cp_analyzer()
+                            if cp is not None and cp.enabled:
+                                keep = tl._copy()
                         # Deferred: derive the labels/latencies now
                         # (the timeline may mutate later), observe at
                         # scrape time (flush_metrics) — Prometheus
@@ -340,7 +376,9 @@ class FlightRecorder:
                             # Terminal wall time: the SLO windows must
                             # see WHEN the request finished, not when
                             # the next scrape drained the backlog.
-                            evt.ts))
+                            evt.ts,
+                            keep,
+                            retained))
 
     def merge(self, request_id: str,
               events: List[Dict[str, Any]]) -> None:
@@ -409,11 +447,14 @@ class FlightRecorder:
                 usage = None
         except Exception:  # noqa: BLE001 — usage plane must not fail scrapes
             usage = None
+        cp = _cp_analyzer()
+        if cp is not None and not cp.enabled:
+            cp = None
         n = 0
         while True:
             try:
-                rid, lat, prio, endpoint, breached, dur_ms, done_ts = \
-                    self._pending_metrics.popleft()
+                (rid, lat, prio, endpoint, breached, dur_ms, done_ts,
+                 carried, retained) = self._pending_metrics.popleft()
             except IndexError:
                 break
             key = (prio, endpoint)
@@ -451,6 +492,28 @@ class FlightRecorder:
                 # here — the only place both sides exist.
                 usage.observe_request(rid, lat, prio, dur_ms,
                                       ts=done_ts)
+            live = self.get(rid) if (cp is not None or retained) \
+                else None
+            if cp is not None:
+                # Critical-path join: prefer the LIVE timeline (post-
+                # finalize merges — a remote replica's events — are
+                # stitched in by now), fall back to the carried copy
+                # when the ring already churned past this request.
+                tl_cp = live if live is not None else carried
+                if tl_cp is not None:
+                    try:
+                        cp.observe(tl_cp, metrics=m)
+                    except Exception:  # noqa: BLE001 — never fail scrape
+                        pass
+            if retained and live is None and carried is not None:
+                # Retention fix: a breached/failed timeline was copied
+                # into the slow buffer at finalize, but BOTH the ring
+                # and the bounded slow buffer can churn past it before
+                # this flush — the carried copy re-retains it so the
+                # slow() debugging surface still has every pending
+                # breach at the scrape that reports it.
+                with self._mu:
+                    self._slow.append(carried)
             n += 1
         with self._mu:
             m.flightrecorder_timelines.set(len(self._ring))
@@ -573,6 +636,23 @@ def configure(cfg) -> FlightRecorder:
                     "is not (enabled=%s emit_metrics=%s) — SLO burn "
                     "rates have no feed and are disabled",
                     rec.enabled, rec.emit_metrics)
+    cp_cfg = getattr(cfg, "critical_path", None)
+    if cp_cfg is not None:
+        from llmq_tpu.observability.critical_path import \
+            configure_critical_path
+        ana = configure_critical_path(cp_cfg)
+        if ana.enabled and not (rec.enabled and rec.emit_metrics):
+            # Same feed contract as SLO/usage: the per-request join is
+            # FED by this recorder's metrics flush. Force-disabling
+            # makes the starved state visible (and keeps the engine's
+            # extra stage marks off) instead of an empty rollup that
+            # reads as "zero latency everywhere".
+            ana.reconfigure(enabled=False)
+            log.warning(
+                "observability.critical_path is enabled but the trace "
+                "plane is not (enabled=%s emit_metrics=%s) — the "
+                "per-request join has no feed and is disabled",
+                rec.enabled, rec.emit_metrics)
     return rec
 
 

@@ -173,6 +173,23 @@ def build_report(compiled: CompiledScenario, stats: RunStats, *,
             "rejections": dict(reg.rejections_total),
             "registry_evictions": reg.evictions_total,
         }
+    try:
+        # Critical-path rollup (observability/critical_path.py): where
+        # the scenario's request time went, per segment — fed by the
+        # same recorder flush as the goodput window above.
+        from llmq_tpu.observability.critical_path import get_critical_path
+        ana = get_critical_path()
+        if ana.enabled and ana.requests > 0:
+            cp = ana.snapshot(recent=0)
+            report["critical_path"] = {
+                "requests": cp["requests"],
+                "conservation_failures": cp["conservation_failures"],
+                "totals_ms": cp["totals_ms"],
+                "share": cp["share"],
+                "dominant": cp["dominant"],
+            }
+    except Exception:  # noqa: BLE001 — report degrades, never dies
+        pass
     return report
 
 

@@ -300,7 +300,7 @@ class TierEntry:
     __slots__ = ("conv_id", "tokens", "length", "pending", "n_pages",
                  "tier", "payload", "pooled", "ready", "demoted_at",
                  "last_used", "wait_since", "loading", "source_tier",
-                 "abandoned", "spilling")
+                 "abandoned", "spilling", "from_exchange")
 
     def __init__(self, conv_id: str, tokens: List[int], length: int,
                  pending: Optional[int], n_pages: int,
@@ -337,6 +337,11 @@ class TierEntry:
         #: already, so the bound enforcement doesn't cascade-spill
         #: everything while the first spill is in flight.
         self.spilling = False
+        #: Materialized from the disagg KV exchange (a cross-replica
+        #: prefill→decode handoff) rather than this replica's own tier
+        #: hierarchy — the critical-path plane names the admission wait
+        #: ``handoff_claim`` instead of ``kv_promote``.
+        self.from_exchange = False
 
 
 # -- the plane -----------------------------------------------------------------
@@ -786,6 +791,7 @@ class KVTieringPlane:
                 entry = TierEntry(conv_id, [], 0, None, 0, now)
                 entry.tier = "store"
                 entry.source_tier = "store"
+                entry.from_exchange = True
                 entry.loading = True
                 self._entries[conv_id] = entry
                 fetch = entry
