@@ -577,6 +577,44 @@ class AsyncPipelineConfig:
                 f"(got {self.completion_workers})")
 
 
+@dataclass
+class SpeculationConfig:
+    """Speculative decoding plane (docs/performance.md "Speculative
+    decoding"): an n-gram/prompt-lookup drafter (zero extra weights —
+    the draft model is the request's own prompt+generated suffix)
+    proposes up to ``draft_k`` tokens per row, the executor verifies
+    the whole window in ONE device program (teacher-forced decode
+    steps), and the engine commits the accepted run plus the correction
+    token per single batched readback — host fetches per token drop
+    below 1. ``enabled: false`` (the DEFAULT) is a hard off-switch: no
+    drafter runs, no verify program is built or compiled, and
+    scheduling/outputs are byte-identical to pre-speculation
+    behavior."""
+    enabled: bool = False
+    #: Max draft tokens proposed per row per window; the verify
+    #: program's static width is draft_k + 1 (drafts + correction).
+    draft_k: int = 4
+    #: Longest suffix n-gram the drafter matches (it backs off to
+    #: shorter n-grams down to 1 before giving up on a window).
+    ngram_max: int = 3
+    #: Device-resident accept: sampling, draft comparison, EOS freeze
+    #: and n_commit all stay inside the jitted window program. ``false``
+    #: runs the unconditional teacher-forced window on device and
+    #: recomputes the accept rule on host from the fetched tokens —
+    #: committed streams are byte-identical either way.
+    device_sampling: bool = True
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.draft_k <= 16:
+            raise ValueError(
+                f"executor.speculation.draft_k must be in [1, 16] "
+                f"(got {self.draft_k})")
+        if self.ngram_max < 1:
+            raise ValueError(
+                f"executor.speculation.ngram_max must be >= 1 "
+                f"(got {self.ngram_max})")
+
+
 VALID_POOL_KINDS = ("none", "subprocess", "exec")
 
 
@@ -949,6 +987,8 @@ class ExecutorConfig:
         default_factory=RaggedAttentionConfig)
     async_pipeline: AsyncPipelineConfig = field(
         default_factory=AsyncPipelineConfig)
+    speculation: SpeculationConfig = field(
+        default_factory=SpeculationConfig)
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

@@ -45,6 +45,8 @@ def build_engine(cfg: Config, *, name: str = "engine0",
     mixed_on = bool(getattr(mixed, "enabled", False))
     pipe = getattr(ex, "async_pipeline", None)
     pipe_on = bool(getattr(pipe, "enabled", False))
+    spec = getattr(ex, "speculation", None)
+    spec_on = bool(getattr(spec, "enabled", False))
     ragged = getattr(ex, "ragged_attention", None)
     ragged_on = bool(getattr(ragged, "enabled", False))
     mesh_cfg = getattr(ex, "mesh", None)
@@ -198,6 +200,13 @@ def build_engine(cfg: Config, *, name: str = "engine0",
                                    else 0),
             ragged_max_slices=(mixed_slices if ragged_on else 0),
             mesh=mesh,
+            # Speculative decoding (docs/performance.md "Speculative
+            # decoding"): draft_k > 0 builds the jitted verify program;
+            # 0 hides verify_chunk entirely so the off-switch keeps the
+            # exact one-token decode path.
+            speculation_draft_k=(spec.draft_k if spec_on else 0),
+            speculation_device_sampling=(spec.device_sampling
+                                         if spec_on else True),
             telemetry_name=name,
             # Warmup runs before InferenceEngine can set the flag.
             telemetry_metrics=metrics_on)
@@ -227,11 +236,12 @@ def build_engine(cfg: Config, *, name: str = "engine0",
         prefix_cache=getattr(ex, "prefix_cache", None),
         mixed_batch=mixed,
         async_pipeline=pipe,
-        kv_tiering=getattr(ex, "kv_tiering", None))
+        kv_tiering=getattr(ex, "kv_tiering", None),
+        speculation=spec)
     tier = getattr(ex, "kv_tiering", None)
     log.info("built %s engine %s (slots=%d pages=%d page_size=%d "
              "mesh=%s prefix_cache=%s mixed_batch=%s ragged_attention=%s "
-             "async_pipeline=%s kv_tiering=%s)",
+             "async_pipeline=%s kv_tiering=%s speculation=%s)",
              ex.backend, name, ex.max_batch_size, ex.kv_pages, ex.page_size,
              (mesh_shape if (ex.backend == "jax" and mesh_shape)
               else "off"),
@@ -242,5 +252,7 @@ def build_engine(cfg: Config, *, name: str = "engine0",
               if ragged_on else "off"),
              (f"on(depth={pipe.depth})" if pipe_on else "off"),
              (f"on(host={tier.host_capacity_mb}MiB)"
-              if getattr(tier, "enabled", False) else "off"))
+              if getattr(tier, "enabled", False) else "off"),
+             (f"on(k={spec.draft_k} device_sampling="
+              f"{spec.device_sampling})" if spec_on else "off"))
     return engine

@@ -342,10 +342,10 @@ class _InflightChunk:
     snapshot of the prefill slices fused into the program — their
     handle.fetch() returns (decode tokens, slice first-tokens)."""
 
-    __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf",
+    __slots__ = ("handle", "seqs", "budgets", "fetch_box", "pf", "spec",
                  "dispatch_s", "dispatched_at")
 
-    def __init__(self, handle, seqs, budgets, pf=None,
+    def __init__(self, handle, seqs, budgets, pf=None, spec=False,
                  dispatch_s: float = 0.0,
                  dispatched_at: float = 0.0) -> None:
         self.handle = handle
@@ -353,6 +353,11 @@ class _InflightChunk:
         self.budgets = budgets    # np.ndarray (B,) int32
         self.fetch_box = None
         self.pf = pf              # List[(seq, n_tokens, final)] | None
+        #: VERIFY window (speculation plane): ``budgets`` holds per-row
+        #: window sizes (upper bounds), ``handle.fetch()`` resolves to
+        #: (out, n_commit) and processing commits/charges only the
+        #: accepted run per row.
+        self.spec = spec
         #: Host-side assembly + dispatch seconds for this chunk — the
         #: "dispatch" leg of the step decomposition; the device/readback
         #: legs are measured at fetch (observability/device.py).
@@ -467,6 +472,7 @@ class InferenceEngine:
         mixed_batch=None,
         async_pipeline=None,
         kv_tiering=None,
+        speculation=None,
     ) -> None:
         self.executor = executor
         self.spec = executor.spec
@@ -710,6 +716,43 @@ class InferenceEngine:
         #: to the exchange from there. Both default to inert.
         self.disagg_role = "unified"
         self.on_conversation_cached = None
+        #: Speculative decoding plane (docs/performance.md "Speculative
+        #: decoding"): drafter + verify-window scheduling replacing the
+        #: one-step-per-token decode cadence. ``speculation`` accepts a
+        #: core.config.SpeculationConfig or anything with its fields;
+        #: None/disabled (the default) keeps the exact pre-speculation
+        #: scheduling — the config's hard off-switch. Also requires an
+        #: executor that carries a verify entry point (built only when
+        #: its speculation knobs are set).
+        self._spec_cfg = (speculation
+                          if speculation is not None
+                          and getattr(speculation, "enabled", False)
+                          else None)
+        self._spec_on = (self._spec_cfg is not None
+                         and callable(getattr(executor, "verify_chunk",
+                                              None)))
+        self._drafter = None
+        if self._spec_on:
+            from llmq_tpu.speculation import NgramDrafter
+            dk = int(getattr(self._spec_cfg, "draft_k", 4))
+            ex_k = getattr(executor, "verify_draft_k", None)
+            if ex_k:
+                # The executor's verify program has a STATIC width —
+                # the drafter must never out-propose it.
+                dk = min(dk, int(ex_k))
+            self._drafter = NgramDrafter(
+                dk, int(getattr(self._spec_cfg, "ngram_max", 3)))
+        #: Speculation counters (engine-local so metrics-off benches can
+        #: still read them): windows reconciled, draft tokens proposed/
+        #: accepted, tokens committed through verify windows, and the
+        #: host fetches that carried them — committed/fetches is the
+        #: readback cadence (tokens per host readback; > 1 means the
+        #: one-fetch-per-token floor is broken).
+        self.spec_windows = 0
+        self.spec_tokens_proposed = 0
+        self.spec_tokens_accepted = 0
+        self.spec_commits_total = 0
+        self.spec_fetches_total = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -2312,6 +2355,11 @@ class InferenceEngine:
         prefill pipeline is strictly faster — full buckets, async
         waves), and at least one mid-prefill slot has a dispatchable
         slice."""
+        if self._spec_on:
+            # Speculation subsumes decode advancement: every decode
+            # token moves through a verify window, so prefill runs
+            # through the dedicated bucket pipeline instead of fusing.
+            return False
         if not self._mixed_on():
             return False
         if not any(s is not None and s.prefilled for s in self._slots):
@@ -2367,6 +2415,12 @@ class InferenceEngine:
         the next speculative one before its same-step join on the fresh
         path — a full chunk of avoidable admission latency, the single
         largest term in realtime p99 under load."""
+        if self._spec_on:
+            # Verify windows never chain device-to-device: the next
+            # window's drafts are keyed off tokens the host has not
+            # fetched yet — every window reconciles before the next
+            # dispatch.
+            return None
         B = self.spec.batch_size
         chunk = max(1, getattr(self.executor, "chunk_size", 1))
         chunk = min(chunk, self._admission_cap())
@@ -2735,16 +2789,22 @@ class InferenceEngine:
         pf_first = None
         if infl.pf is not None:
             out, pf_first = out      # mixed chunk: (decode, slice firsts)
+        ncommit = None
+        if infl.spec:
+            out, ncommit = out       # verify window: (tokens, n_commit)
         if self._usage.enabled or self._cp.enabled:
             # Attribute BEFORE committing: rows that finish during the
             # commit loop (EOS) finalize their ledger record there and
-            # must already carry this chunk's share.
+            # must already carry this chunk's share. Verify windows
+            # weigh rows by the ACCEPTED token counts (speculation
+            # attribution satellite), plain chunks by dispatch budgets.
             parts = []
             decode_rows = []
             for slot in range(self.spec.batch_size):
                 seq = infl.seqs[slot]
                 if seq is not None and seq.slot == slot:
-                    w = max(1, int(infl.budgets[slot]))
+                    w = max(1, int(ncommit[slot] if ncommit is not None
+                                   else infl.budgets[slot]))
                     parts.append((seq, w, False))
                     decode_rows.append((seq, w))
             if infl.pf is not None:
@@ -2758,12 +2818,21 @@ class InferenceEngine:
                 self._cp_decode_share(device_s + readback_s, parts,
                                       decode_rows)
         tok0 = self.tokens_generated_total
+        pairs = []
         for slot in range(self.spec.batch_size):
             seq = infl.seqs[slot]
             if seq is None or seq.slot != slot:
                 continue    # finished while the chunk was in flight
-            self._commit_row(seq, out[slot], int(infl.budgets[slot]))
+            if infl.spec:
+                self._commit_row(seq, out[slot], int(ncommit[slot]))
+                pairs.append((int(infl.budgets[slot]),
+                              int(ncommit[slot])))
+                self._spec_trim(seq)
+            else:
+                self._commit_row(seq, out[slot], int(infl.budgets[slot]))
             self._flush_emits(seq)
+        if infl.spec:
+            self._note_spec_window(pairs)
         if infl.pf is not None:
             self._finish_mixed_prefills(infl.pf, pf_first)
         self._telemetry.note_step(infl.dispatch_s, device_s, readback_s,
@@ -2842,6 +2911,8 @@ class InferenceEngine:
                     budgets_by_order[s.order] = max(1, int(b * scale))
 
     def _decode_once(self) -> bool:
+        if self._spec_on:
+            return self._spec_once()
         B = self.spec.batch_size
         chunk = max(1, getattr(self.executor, "chunk_size", 1))
         chunk = min(chunk, self._admission_cap())
@@ -2960,6 +3031,196 @@ class InferenceEngine:
                                   self.tokens_generated_total - tok0)
         self._set_gauges()
         return True
+
+    def _spec_once(self) -> bool:
+        """Dispatch ONE speculative VERIFY window (docs/performance.md
+        "Speculative decoding"): per prefilled row the n-gram drafter
+        proposes up to draft_k tokens out of the row's own committed
+        stream, the executor verifies the whole window in one device
+        program, and reconciliation commits the accepted run plus the
+        correction token — so one host readback advances a row by up to
+        draft_k + 1 tokens. Rows whose lookup comes up empty (or whose
+        budget is 1) ride the same program as plain single steps, so
+        every decode advancement flows through this path while the
+        plane is on. Joining rows (unresolved ``first_handle``) are NOT
+        fused here — their first token commits at the next
+        ``_resolve_prefills`` and they enter the following window.
+
+        Equivalence contract: the committed stream is byte-identical to
+        spec-off — greedy by the teacher-forced verify construction,
+        temperature by position-keyed sampling (a committed token is a
+        deterministic function of (row, absolute position, prefix))."""
+        B = self.spec.batch_size
+        drafter = self._drafter
+        K = drafter.draft_k
+        # Window length is the drafter's k plus the correction slot —
+        # NOT capped by the plain decode chunk size. A verify window is
+        # its own device program (the drafts/qlens shapes are keyed to
+        # draft_k, not chunk_size); clamping it to the chunk would
+        # forfeit the whole plane whenever draft_k + 1 > chunk_size.
+        # The admission cap still binds: an urgent waiter must not sit
+        # out a long window any more than a long chunk.
+        win = max(1, min(K + 1, self._admission_cap()))
+        active = [s for s in self._slots if s is not None and s.prefilled]
+        if not active:
+            self._set_gauges()
+            return False
+        budgets_by_order = self._budget_chunk_rows(win, active)
+        active = [s for s in self._slots
+                  if s is not None and s.prefilled
+                  and s.order in budgets_by_order]
+        if not active:
+            self._set_gauges()
+            return False
+
+        t_asm = time.perf_counter()   # step decomposition: dispatch leg
+        st = self._staging
+        tokens = st.take("spec.tok", (B,), np.int32)
+        positions = st.take("spec.pos", (B,), np.int32)
+        block_tables = st.take("spec.bt",
+                               (B, self.spec.max_pages_per_seq), np.int32)
+        temps = st.take("spec.temp", (B,), np.float32)
+        drafts = st.take("spec.draft", (B, K), np.int32)
+        qlens = np.zeros(B, np.int32)   # read again at process time
+        for seq in active:
+            i = seq.slot
+            budget = budgets_by_order[seq.order]
+            # Context = the committed stream: tokens whose KV is
+            # written plus the pending last sample (next decode input).
+            d = (drafter.propose(seq.written_ids + [seq.last_token],
+                                 budget - 1)
+                 if budget > 1 else [])
+            if d:
+                drafts[i, :len(d)] = d
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            block_tables[i] = seq.block_table
+            temps[i] = seq.req.temperature
+            # Window writes KV at [pos, pos + w); pages for the full
+            # budget (≥ w) were ensured in _budget_chunk_rows — the
+            # rejected tail's pages are trimmed back at reconcile.
+            qlens[i] = 1 + len(d)
+        start_fn = getattr(self.executor, "verify_chunk_start", None)
+        if start_fn is not None:
+            # Pipelined: dispatch only — (out, n_commit) are fetched on
+            # the NEXT step; the fetch overlaps arrival servicing.
+            with self._prof.span("engine.verify_dispatch",
+                                 active=len(active),
+                                 chunk=int(qlens.max())):
+                handle = start_fn(tokens, positions, block_tables, temps,
+                                  drafts, qlens)
+            now = time.perf_counter()
+            dispatch_s = now - t_asm
+            _prefetch(getattr(handle, "out", None))
+            seqs = [None] * B
+            for seq in active:
+                seqs[seq.slot] = seq
+            infl = _InflightChunk(handle, seqs, qlens, spec=True,
+                                  dispatch_s=dispatch_s,
+                                  dispatched_at=now)
+            self._inflight.append(infl)
+            self._note_dispatch_depth(len(self._inflight))
+            self._start_fetch(infl)
+            self.steps += 1
+            if self._metrics:
+                self._metrics.decode_steps.labels(self.name).inc()
+            return True
+        t_call = time.perf_counter()
+        with self._prof.span("engine.verify_chunk", active=len(active),
+                             chunk=int(qlens.max())):
+            out, ncommit = self.executor.verify_chunk(
+                tokens, positions, block_tables, temps, drafts, qlens)
+        t_done = time.perf_counter()
+        out = np.asarray(out)
+        ncommit = np.asarray(ncommit)   # readback fence (no-op for echo)
+        t_rb = time.perf_counter()
+        self.steps += 1
+        if self._metrics:
+            self._metrics.decode_steps.labels(self.name).inc()
+        if self._usage.enabled or self._cp.enabled:
+            # Satellite of the speculation plane: device-seconds charge
+            # the ACCEPTED token counts, not the dispatched window
+            # bounds — a row whose drafts all missed weighs 1, exactly
+            # like a plain step.
+            parts = [(seq, max(1, int(ncommit[seq.slot])), False)
+                     for seq in active if seq.slot is not None]
+            if self._usage.enabled:
+                self._charge_step(t_done - t_call, parts)
+            if self._cp.enabled:
+                self._cp_decode_share(
+                    (t_done - t_call) + (t_rb - t_done), parts,
+                    [(seq, w) for seq, w, _ in parts])
+        tok0 = self.tokens_generated_total
+        pairs = []
+        for seq in active:
+            slot = seq.slot
+            self._commit_row(seq, out[slot], int(ncommit[slot]))
+            pairs.append((int(qlens[slot]), int(ncommit[slot])))
+            self._spec_trim(seq)
+            self._flush_emits(seq)
+        self._note_spec_window(pairs)
+        self._telemetry.note_step(t_call - t_asm, t_done - t_call,
+                                  t_rb - t_done,
+                                  self.tokens_generated_total - tok0)
+        self._set_gauges()
+        return True
+
+    def _spec_trim(self, seq: _Sequence) -> None:
+        """KV rollback for a reconciled verify window: pages past the
+        committed position hold only the rejected tail's stale KV —
+        return them to the pool (the allocator resolves each page's dp
+        universe from its id, so a page allocated for this very window
+        goes back where it came from). Mirrors ``_finish_active``'s
+        pre-pin trim. No-op for a finished/shed sequence — its pages
+        were already released wholesale."""
+        if seq.slot is None:
+            return
+        keep = PageAllocator.pages_for(seq.pos, self.spec.page_size)
+        if len(seq.pages) <= keep:
+            return
+        extra = seq.pages[keep:]
+        seq.pages = seq.pages[:keep]
+        seq.block_table[keep:keep + len(extra)] = 0
+        self.allocator.free(extra)
+        self._usage_pages(seq)
+
+    def _note_spec_window(self, pairs) -> None:
+        """Speculation telemetry for one reconciled verify window.
+        ``pairs``: (window_size w, n_commit) per COMMITTED row — rows
+        skipped at reconcile (finished while in flight) are excluded so
+        the readback cadence stays truthful. Per drafted row (w > 1)
+        the acceptance rate observes (n-1)/(w-1); the cadence gauge is
+        cumulative committed tokens per host fetch."""
+        proposed = 0
+        accepted = 0
+        committed = 0
+        for w, n in pairs:
+            if w <= 0:
+                continue
+            n = max(0, n)
+            committed += n
+            if w > 1:
+                proposed += w - 1
+                acc = max(0, n - 1)
+                accepted += acc
+                if self._metrics:
+                    self._metrics.spec_acceptance.labels(
+                        self.name).observe(acc / (w - 1))
+        self.spec_windows += 1
+        self.spec_tokens_proposed += proposed
+        self.spec_tokens_accepted += accepted
+        self.spec_commits_total += committed
+        self.spec_fetches_total += 1
+        if self._metrics:
+            if proposed:
+                self._metrics.spec_tokens_proposed.labels(
+                    self.name).inc(proposed)
+            if accepted:
+                self._metrics.spec_tokens_accepted.labels(
+                    self.name).inc(accepted)
+            self._metrics.spec_readback_cadence.labels(self.name).set(
+                self.spec_commits_total / self.spec_fetches_total)
+        self._telemetry.note_spec(proposed, accepted, committed)
 
     def _mixed_once(self) -> bool:
         """Dispatch ONE mixed iteration: the active decode rows' chunk
@@ -3540,6 +3801,26 @@ class InferenceEngine:
             # Tiered KV plane (docs/tiering.md): residency per tier,
             # hit breakdown incl. recompute, spill/round-trip counts.
             out["kv_tiering"] = self._tiering.stats()
+        if self._spec_on:
+            # Speculation plane (docs/performance.md "Speculative
+            # decoding"): acceptance and readback cadence — what
+            # bench.py reports as the LLMQ_BENCH_SPECULATION deltas.
+            out["speculation"] = {
+                "draft_k": self._drafter.draft_k,
+                "windows": self.spec_windows,
+                "tokens_proposed": self.spec_tokens_proposed,
+                "tokens_accepted": self.spec_tokens_accepted,
+                "acceptance_rate": (
+                    round(self.spec_tokens_accepted
+                          / self.spec_tokens_proposed, 4)
+                    if self.spec_tokens_proposed else 0.0),
+                "tokens_committed": self.spec_commits_total,
+                "fetches": self.spec_fetches_total,
+                "readback_cadence": (
+                    round(self.spec_commits_total
+                          / self.spec_fetches_total, 4)
+                    if self.spec_fetches_total else 0.0),
+            }
         if self._prefix_cache is not None:
             pc = self._prefix_cache.get_stats()
             total = self.prefix_hits + self.prefix_misses

@@ -1,0 +1,16 @@
+"""Speculative decoding plane (docs/performance.md "Speculative
+decoding"): an n-gram/prompt-lookup drafter proposes tokens out of the
+request's own prompt+generated suffix, the executor verifies a whole
+window in ONE device program (teacher-forced decode steps with
+device-resident sampling), and the engine commits the accepted run plus
+the correction token per single readback — breaking the
+one-host-visible-iteration-per-token floor.
+
+``executor.speculation.enabled: false`` (the default) is a hard
+off-switch: no drafter runs, no verify program is built, and the engine
+schedules byte-identically to pre-speculation behavior.
+"""
+
+from llmq_tpu.speculation.ngram import NgramDrafter, propose_ngram
+
+__all__ = ["NgramDrafter", "propose_ngram"]

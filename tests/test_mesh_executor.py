@@ -852,7 +852,8 @@ def key_for(mesh_, dp_shards, cache):
         chunk_size=16, prefill_batch=4, prefill_buckets=[512],
         _top_k=0, _top_p=1.0, mixed_prefill_slices=0,
         mixed_slice_tokens=0, ragged_attention=False,
-        _ragged_buf=0, _ragged_qblk=0, mesh=mesh_,
+        _ragged_buf=0, _ragged_qblk=0,
+        verify_draft_k=0, _spec_device_sampling=True, mesh=mesh_,
         dp_shards=dp_shards, params=abs_params, cache=cache)
     return JaxExecutor._export_cache_key(stub)
 
