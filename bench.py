@@ -1383,6 +1383,75 @@ def bench_scenarios(scale: float = 0.1,
     return out
 
 
+def bench_store_chaos(scale: float = 0.1) -> Dict:
+    """Store fault domain A/B (docs/robustness.md "Store fault
+    domain"): the ``store_brownout`` scenario — a diurnal multi-turn
+    mix whose shared store blacks out mid-run, then browns out with
+    200 ms injected latency — run twice on the same seed:
+
+    - **domain**: the resilience wrapper as shipped (bounded op
+      deadlines, breaker, degraded ladder, recovery drain);
+    - **no_domain**: the same store seam (so the same chaos rules
+      fire) but every protection neutralized — a 30 s op deadline,
+      zero retries, no breaker, a timeout ladder that never flips —
+      i.e. consumers eat every raw error and every slow op.
+
+    The delta is the domain's value on a NAMED workload: wall time
+    (how long the brownout holds hot paths), SLO attainment and
+    completion count. Zero-loss invariants must hold on BOTH legs."""
+    import logging
+
+    from llmq_tpu.core.config import StoreResilienceConfig
+    from llmq_tpu.scenarios import load_named, run_scenario
+    from llmq_tpu.scenarios.library import _store_target
+
+    # CRITICAL, not ERROR: this bench INDUCES hundreds of store
+    # errors per leg; their per-op tracebacks are the measurement,
+    # not a problem to report.
+    for noisy in ("llmq.engine", "llmq.supervisor", "llmq.chaos",
+                  "llmq.tiering", "llmq.disagg", "llmq.conversation",
+                  "llmq.store.resilience", "llmq.scenarios"):
+        logging.getLogger(noisy).setLevel(logging.CRITICAL)
+
+    def leg(rcfg) -> Dict:
+        spec = load_named("store_brownout")
+        target = _store_target(spec, rcfg=rcfg)
+        t0 = time.perf_counter()
+        rep = run_scenario(spec, target=target, scale=scale)
+        target.stop()
+        req = rep["requests"]
+        row = {
+            "goodput_tps": rep["goodput"].get(
+                "tokens_per_device_second"),
+            "slo_attainment": rep["slo"]["attainment"],
+            "completed": req["completed"],
+            "failed": req["failed"],
+            "invariant_violations": rep["invariants"]["violations"],
+            "wall_s": round(time.perf_counter() - t0, 2),
+        }
+        st = target.store.resilience_stats()
+        row["store"] = {k: st.get(k) for k in
+                        ("ops", "errors", "timeouts", "retries", "shed")}
+        return row
+
+    neutralized = StoreResilienceConfig(
+        enabled=True, op_timeout_s=30.0, retries=0,
+        timeout_threshold=10**9, probe_interval_s=0.0, seed=1)
+    neutralized.breaker.enabled = False
+    out: Dict = {"scale": scale,
+                 "domain": leg(None),
+                 "no_domain": leg(neutralized)}
+    d, n = out["domain"], out["no_domain"]
+    if n["wall_s"]:
+        out["wall_s_saved_pct"] = round(
+            100.0 * (n["wall_s"] - d["wall_s"]) / n["wall_s"], 1)
+    log(f"[store_chaos] domain: slo={d['slo_attainment']} "
+        f"completed={d['completed']} shed={d['store']['shed']} "
+        f"wall={d['wall_s']}s | no_domain: slo={n['slo_attainment']} "
+        f"completed={n['completed']} wall={n['wall_s']}s")
+    return out
+
+
 def bench_tpu_decode(model_name: str, batch: int, steps: int,
                      quant: str = "") -> Optional[Dict]:
     import jax
@@ -2435,6 +2504,15 @@ def main() -> None:
                     "LLMQ_BENCH_SCENARIOS", "").split(",") if n] or None)
         except Exception as e:  # noqa: BLE001
             log(f"[scenarios] failed: {type(e).__name__}: {e}")
+    store_chaos_res = None
+    if not os.environ.get("LLMQ_BENCH_SKIP_STORE_CHAOS"):
+        try:
+            store_chaos_res = bench_store_chaos(
+                scale=float(os.environ.get(
+                    "LLMQ_BENCH_STORE_CHAOS_SCALE", "0.1")))
+        except Exception as e:  # noqa: BLE001
+            log(f"[store_chaos] A/B bench failed: "
+                f"{type(e).__name__}: {e}")
     tpu = None
     tpu_tiers = None
     tpu_tiers_8b = None
@@ -2474,6 +2552,7 @@ def main() -> None:
         "controlplane": controlplane_res,
         "speculation": speculation_res,
         "scenario_runs": scenarios_res,
+        "store_chaos": store_chaos_res,
         "tpu": tpu,
         "tpu_tiers": tpu_tiers,
         "tpu_tiers_8b": tpu_tiers_8b,
@@ -2513,6 +2592,18 @@ def main() -> None:
                 name: row.get("goodput_tps")
                 for name, row in ((scenarios_res or {})
                                   .get("scenarios") or {}).items()},
+            # Store fault-domain A/B (docs/robustness.md): the
+            # brownout scenario's SLO attainment with the domain on
+            # vs neutralized, and the wall-time the bounded deadlines
+            # + degraded ladder save under the same blackout.
+            "store_chaos_slo_domain":
+                ((store_chaos_res or {}).get("domain") or {})
+                .get("slo_attainment"),
+            "store_chaos_slo_no_domain":
+                ((store_chaos_res or {}).get("no_domain") or {})
+                .get("slo_attainment"),
+            "store_chaos_wall_s_saved_pct":
+                (store_chaos_res or {}).get("wall_s_saved_pct"),
             "decode_tokens_per_s": (tpu or {}).get("decode_tokens_per_s"),
             # Speculation A/B (docs/performance.md "Speculative
             # decoding"): echo-engine decode throughput with the

@@ -73,6 +73,14 @@ class App:
                            sqlite_path=cfg.persistence.sqlite_path,
                            redis_url=cfg.persistence.redis_url,
                            key_prefix=cfg.persistence.key_prefix)
+        # Store fault domain (conversation/resilience.py,
+        # docs/robustness.md): bounded op deadlines + retry + breaker
+        # around the ONE store every store-backed plane shares. Hard
+        # off-switch: store.resilience.enabled=false (default) keeps
+        # the raw backend — nothing below can tell the difference.
+        if cfg.store.resilience.enabled:
+            from llmq_tpu.conversation.resilience import wrap_store
+            store = wrap_store(store, cfg.store.resilience)
         self.state_manager = StateManager(cfg.conversation, store=store)
         self.load_balancer = LoadBalancer(cfg.loadbalancer)
         self.resource_scheduler = ResourceScheduler(cfg.resource_scheduler)

@@ -36,7 +36,8 @@ from urllib.parse import parse_qs, urlparse
 from llmq_tpu import __version__, observability
 from llmq_tpu.api.message_store import MessageStore
 from llmq_tpu.core.config import Config, default_config
-from llmq_tpu.core.errors import QueueFullError, QueueNotFoundError
+from llmq_tpu.core.errors import (QueueFullError, QueueNotFoundError,
+                                  WALError)
 from llmq_tpu.core.types import (ConversationState, Message,
                                  MessageStatus, Priority, new_id)
 from llmq_tpu.utils.logging import get_logger
@@ -308,6 +309,12 @@ class ApiServer:
                 return 404, {"error": str(e)}, "application/json"
             except QueueFullError as e:
                 return 503, {"error": str(e)}, "application/json"
+            except WALError as e:
+                # Durability journal can't record the op (disk full /
+                # IO fault): explicit 503 shed + Retry-After — the
+                # worker loop stays up (docs/robustness.md).
+                return 503, {"error": str(e), "retry_after": 1.0}, \
+                    "application/json"
             except Exception as e:  # noqa: BLE001
                 log.exception("handler error on %s %s", method, path)
                 return 500, {"error": f"internal error: {e}"}, "application/json"
@@ -453,7 +460,32 @@ class ApiServer:
                     out["boot"] = boot
         except Exception:  # noqa: BLE001 — health must never fail on telemetry
             pass
+        store_block = self._store_block()
+        if store_block is not None:
+            # Store fault domain (docs/robustness.md): present only
+            # when the resilience wrapper is active — pre-feature
+            # health bodies stay byte-identical.
+            out["store"] = store_block
         return 200, out
+
+    def _store_block(self) -> Optional[Dict[str, Any]]:
+        """The resilience wrapper's health/overview block, or None when
+        the store plane is off (raw backend / no state manager)."""
+        sm = self.state_manager
+        if sm is None:
+            return None
+        stats_fn = getattr(getattr(sm, "store", None),
+                           "resilience_stats", None)
+        if not callable(stats_fn):
+            return None
+        try:
+            block = dict(stats_fn())
+            pending = getattr(sm, "replay_pending", None)
+            if callable(pending):
+                block["replay_pending"] = pending()
+            return block
+        except Exception:  # noqa: BLE001 — health must never fail on
+            return None    # the store plane
 
     def metrics_exposition(self, req: _Request) -> Tuple[int, Any]:
         from llmq_tpu.metrics.registry import exposition
@@ -1050,6 +1082,12 @@ class ApiServer:
             # last action + reason, target vs live replicas, burn
             # inputs — the operator's one-stop view.
             out["controller"] = self.controller.snapshot()
+        store_block = self._store_block()
+        if store_block is not None:
+            # Store fault domain block (docs/robustness.md): breaker
+            # state, degraded consumers, replay backlog. Absent when
+            # the plane is off — pre-feature bodies stay byte-identical.
+            out["store"] = store_block
         return 200, out
 
     def generate_sync(self, req: _Request) -> Tuple[int, Any]:

@@ -16,7 +16,19 @@ fault point                seam
                            allocation failure — request stays pending)
 ``wal.append``             QueueWAL.append, before the journal write
 ``wal.fsync``              QueueWAL fsync sites (append window + close)
+``store.get``              ResilientStore load/list (conversation reads)
+``store.put``              ResilientStore save (conversation writes)
+``store.delete``           ResilientStore delete
+``store.kv``               ResilientKVStore save_kv/load_kv/delete_kv/
+                           list_kv (tiering spill + disagg exchange)
 =========================  =============================================
+
+The ``store.*`` points fire INSIDE the resilience wrapper's bounded
+worker (conversation/resilience.py), so an injected ``latency`` longer
+than ``store.resilience.op_timeout_s`` surfaces as a deadline miss —
+exactly like a slow real backend — and ``error`` faults feed the
+store-scoped breaker/retry ladder. A raw (unwrapped) store has no
+fault points: the seam only exists when the fault domain is on.
 
 Usage contract for an instrumented seam is one line::
 

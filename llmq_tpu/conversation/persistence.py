@@ -21,9 +21,13 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import sqlite3
 import threading
-from typing import Dict, List, Optional, Protocol
+import zlib
+from typing import Callable, Dict, List, Optional, Protocol, TypeVar
+
+_T = TypeVar("_T")
 
 from llmq_tpu.core.types import Conversation
 from llmq_tpu.utils.logging import get_logger
@@ -114,11 +118,48 @@ class SqliteStore:
     existing pre-tiering database upgrades in place on open."""
 
     _BUSY_TIMEOUT_MS = 10_000
+    #: Bounded application-level retry on ``database is locked`` at the
+    #: KV-payload ops. ``busy_timeout`` only queues while the writer's
+    #: lock is HELD; a writer that loses the race at COMMIT time under
+    #: WAL still raises immediately — the tiering worker and the state
+    #: manager hammering kv_payloads from different threads hit exactly
+    #: that window (pinned by the 4-thread contention test).
+    _LOCKED_RETRIES = 4
+    _LOCKED_BASE_BACKOFF_S = 0.005
+    _LOCKED_MAX_BACKOFF_S = 0.05
 
     def __init__(self, path: str = "llmq_state.db") -> None:
         self._path = path
         self._local = threading.local()
+        # Seeded per-path jitter stream so chaos/contention tests
+        # replay deterministically (same discipline as the breaker's).
+        self._retry_rng = random.Random(zlib.crc32(path.encode("utf-8")))
+        self._retry_mu = threading.Lock()
         self._init_schema()
+
+    def _with_locked_retry(self, fn: Callable[[], _T]) -> _T:
+        # lint: allow-wallclock — backoff sleep only; nothing schedules.
+        import time
+
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if (attempt >= self._LOCKED_RETRIES
+                        or ("locked" not in msg and "busy" not in msg)):
+                    raise
+                attempt += 1
+                backoff = min(
+                    self._LOCKED_MAX_BACKOFF_S,
+                    self._LOCKED_BASE_BACKOFF_S * (2 ** (attempt - 1)))
+                with self._retry_mu:
+                    backoff *= 1.0 + 0.2 * (
+                        2.0 * self._retry_rng.random() - 1.0)
+                log.debug("sqlite locked (%s); retry %d/%d in %.1fms",
+                          e, attempt, self._LOCKED_RETRIES, backoff * 1e3)
+                time.sleep(max(0.0, backoff))
 
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
@@ -218,31 +259,40 @@ class SqliteStore:
         # only; nothing schedules off it.
         import time
 
-        conn = self._conn()
-        with conn:
-            conn.execute(
-                """INSERT INTO kv_payloads
-                   (conversation_id, payload, nbytes, updated_at)
-                   VALUES (?,?,?,?)
-                   ON CONFLICT(conversation_id) DO UPDATE SET
-                     payload=excluded.payload, nbytes=excluded.nbytes,
-                     updated_at=excluded.updated_at""",
-                (conversation_id, sqlite3.Binary(bytes(blob)),
-                 len(blob), time.time()))
+        def _write() -> None:
+            conn = self._conn()
+            with conn:
+                conn.execute(
+                    """INSERT INTO kv_payloads
+                       (conversation_id, payload, nbytes, updated_at)
+                       VALUES (?,?,?,?)
+                       ON CONFLICT(conversation_id) DO UPDATE SET
+                         payload=excluded.payload, nbytes=excluded.nbytes,
+                         updated_at=excluded.updated_at""",
+                    (conversation_id, sqlite3.Binary(bytes(blob)),
+                     len(blob), time.time()))
+
+        self._with_locked_retry(_write)
 
     def load_kv(self, conversation_id: str) -> Optional[bytes]:
-        cur = self._conn().execute(
-            "SELECT payload FROM kv_payloads WHERE conversation_id=?",
-            (conversation_id,))
-        row = cur.fetchone()
-        return bytes(row[0]) if row is not None else None
+        def _read() -> Optional[bytes]:
+            cur = self._conn().execute(
+                "SELECT payload FROM kv_payloads WHERE conversation_id=?",
+                (conversation_id,))
+            row = cur.fetchone()
+            return bytes(row[0]) if row is not None else None
+
+        return self._with_locked_retry(_read)
 
     def delete_kv(self, conversation_id: str) -> None:
-        conn = self._conn()
-        with conn:
-            conn.execute(
-                "DELETE FROM kv_payloads WHERE conversation_id=?",
-                (conversation_id,))
+        def _drop() -> None:
+            conn = self._conn()
+            with conn:
+                conn.execute(
+                    "DELETE FROM kv_payloads WHERE conversation_id=?",
+                    (conversation_id,))
+
+        self._with_locked_retry(_drop)
 
     def list_kv(self) -> List[str]:
         cur = self._conn().execute(

@@ -26,7 +26,8 @@ host state and HBM state.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
 
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.config import ConversationConfig
@@ -62,6 +63,25 @@ class StateManager:
         self._on_evict: List[Callable[[Conversation], None]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Store fault domain (conversation/resilience.py,
+        # docs/robustness.md): while the wrapped store is degraded the
+        # manager serves history from its in-memory cache and journals
+        # write-behind conversation ids into a bounded replay buffer,
+        # drained on the store's recovery callback. All duck-typed —
+        # a raw backend (resilience off) leaves every path identical.
+        cap = 256
+        rcfg = getattr(self._store, "config", None)
+        if rcfg is not None:
+            cap = max(1, int(getattr(rcfg, "replay_buffer", cap)))
+        self._replay: Deque[str] = deque(maxlen=cap)
+        self._replay_mu = threading.Lock()
+        reg = getattr(self._store, "register_consumer", None)
+        if callable(reg):
+            reg("state")
+            reg("placement")
+        rec = getattr(self._store, "on_recovery", None)
+        if callable(rec):
+            rec(self.drain_replay)
 
     @property
     def store(self) -> ConversationStore:
@@ -69,6 +89,47 @@ class StateManager:
         payloads through the same store's ``save_kv``/``load_kv``
         methods when it implements them — persistence.KVPayloadStore)."""
         return self._store
+
+    def _store_degraded(self) -> bool:
+        """Degraded ladder rung check (False for raw backends): while
+        True, reads serve the in-memory cache only and writes journal
+        into the replay buffer — nobody pays a store round-trip that is
+        known to shed."""
+        return bool(getattr(self._store, "degraded", False))
+
+    def replay_pending(self) -> int:
+        with self._replay_mu:
+            return len(self._replay)
+
+    def drain_replay(self) -> int:
+        """Flush journaled write-behind conversations back to the
+        recovered store. Runs on the resilience wrapper's recovery
+        callback (and is safe to call any time). Conversations evicted
+        from memory since journaling are skipped — their last archived
+        state was the journaled one, which is exactly what was lost;
+        the next turn recreates them. Re-journals on a fresh failure
+        (the store may bounce)."""
+        drained = 0
+        while True:
+            with self._replay_mu:
+                if not self._replay:
+                    break
+                cid = self._replay.popleft()
+            with self._mu:
+                conv = self._convs.get(cid)
+            if conv is None:
+                continue
+            try:
+                self._store.save(conv)
+                drained += 1
+            except Exception:  # noqa: BLE001 — store bounced; re-park
+                with self._replay_mu:
+                    self._replay.append(cid)
+                break
+        if drained:
+            log.info("store replay buffer drained: %d conversations "
+                     "re-persisted", drained)
+        return drained
 
     # -- KV pinning hooks ----------------------------------------------------
 
@@ -192,7 +253,7 @@ class StateManager:
                 self._fire(self._on_touch, conv)
                 return conv
         loaded: Optional[Conversation] = None
-        if self._persist:
+        if self._persist and not self._store_degraded():
             try:
                 loaded = self._store.load(conversation_id)
             except Exception:  # noqa: BLE001
@@ -213,8 +274,12 @@ class StateManager:
     def get(self, conversation_id: str) -> Conversation:
         with self._mu:
             conv = self._convs.get(conversation_id)
-        if conv is None and self._persist:
-            conv = self._store.load(conversation_id)
+        if conv is None and self._persist and not self._store_degraded():
+            try:
+                conv = self._store.load(conversation_id)
+            except Exception:  # noqa: BLE001 — degraded rung: cache-only
+                log.exception("store load failed for %s", conversation_id)
+                conv = None
             if conv is not None:
                 with self._mu:
                     self._admit_locked(conv)
@@ -331,7 +396,7 @@ class StateManager:
             local = [self._convs[cid]
                      for cid in self._user_convs.get(user_id, [])
                      if cid in self._convs]
-        if self._persist:
+        if self._persist and not self._store_degraded():
             try:
                 for cid in self._store.list_user(user_id):
                     if all(c.id != cid for c in local):
@@ -390,10 +455,31 @@ class StateManager:
     def _save(self, conv: Conversation) -> None:
         if not self._persist:
             return
+        if self._store_degraded():
+            # Write-behind ladder rung: journal, don't burn the probe
+            # slot on every save. Drained by drain_replay on recovery.
+            with self._replay_mu:
+                if conv.id in self._replay:
+                    return
+                if (self._replay.maxlen is not None
+                        and len(self._replay) >= self._replay.maxlen):
+                    log.warning(
+                        "store replay buffer full (%d); dropping oldest "
+                        "journaled write", self._replay.maxlen)
+                self._replay.append(conv.id)
+            return
         try:
             self._store.save(conv)
         except Exception:  # noqa: BLE001
             log.exception("store save failed for %s", conv.id)
+            with self._replay_mu:
+                if conv.id not in self._replay:
+                    self._replay.append(conv.id)
+            return
+        # Opportunistic drain: raw backends (no recovery callback) that
+        # journaled on a transient failure flush as soon as writes work.
+        if self.replay_pending():
+            self.drain_replay()
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -210,6 +210,56 @@ class BreakerConfig:
 
 
 @dataclass
+class StoreResilienceConfig:
+    """Store fault domain (conversation/resilience.py,
+    docs/robustness.md): bounded deadlines, seeded retry and a
+    store-scoped breaker wrapped around whichever ConversationStore /
+    KVPayloadStore backend serves the tiering spill, the KV exchange,
+    placement records and restart rehydration. Off by default — the
+    wrapped store is byte-identical to the raw backend when disabled."""
+    enabled: bool = False
+    #: Hard wall deadline per store operation, in seconds. A dead OR
+    #: slow store can never hold a hot path longer than this (plus
+    #: bounded retries below).
+    op_timeout_s: float = 0.25
+    #: Bounded retry attempts for retryable errors only (sqlite
+    #: ``database is locked``, redis connection resets).
+    retries: int = 2
+    #: Jittered-exponential retry backoff (seconds), seeded so chaos
+    #: scenarios replay deterministically.
+    retry_base_backoff_s: float = 0.01
+    retry_max_backoff_s: float = 0.2
+    retry_jitter: float = 0.2
+    #: Consecutive per-op deadline misses that flip the store into
+    #: timeout-degraded mode (the breaker core is timeout-neutral, so
+    #: slow-not-dead stores need their own ladder rung).
+    timeout_threshold: int = 3
+    #: While timeout-degraded, one probe op is admitted per interval;
+    #: everything else sheds fast to the consumer's degraded mode.
+    probe_interval_s: float = 1.0
+    #: Bounded replay buffer of conversation writes journaled by the
+    #: state manager while the store is degraded; drained on recovery.
+    replay_buffer: int = 256
+    #: Seed for retry jitter and the breaker's backoff jitter.
+    seed: int = 0
+    #: Store-scoped breaker (same core as cluster dispatch, PR 5 rules:
+    #: faults trip it, deadline misses never do).
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
+
+
+@dataclass
+class StoreConfig:
+    """Store-tier fault domain knobs (docs/robustness.md)."""
+    resilience: StoreResilienceConfig = field(
+        default_factory=StoreResilienceConfig)
+
+    @property
+    def enabled(self) -> bool:
+        """Off-switch alias: the plane is the resilience wrapper."""
+        return self.resilience.enabled
+
+
+@dataclass
 class ClusterConfig:
     """Replica-set serving plane (llmq_tpu/cluster/, docs/multihost.md).
 
@@ -1018,6 +1068,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     disagg: DisaggConfig = field(default_factory=DisaggConfig)
     conversation: ConversationConfig = field(default_factory=ConversationConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     observability: ObservabilityConfig = field(

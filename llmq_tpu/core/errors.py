@@ -40,6 +40,18 @@ class MessageNotFoundError(LLMQError, KeyError):
         self.message_id = message_id
 
 
+class WALError(LLMQError, OSError):
+    """The durability journal could not record an admission-path op
+    (disk full / IO fault). The REST layer sheds the request with a
+    503 (+ Retry-After) rather than accepting work whose at-least-once
+    promise cannot be kept (docs/robustness.md). Subclasses OSError so
+    callers treating a failed push as an IO fault keep working."""
+
+    def __init__(self, op: str, cause: str) -> None:
+        super().__init__(f"WAL {op} failed: {cause}")
+        self.op = op
+
+
 # --- conversation service ---------------------------------------------------
 
 class ConversationNotFoundError(LLMQError, KeyError):

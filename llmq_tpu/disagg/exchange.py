@@ -84,6 +84,12 @@ class KVExchange:
         self._handoff_ms: List[Tuple[str, float]] = []
         #: Lifetime totals for stats()/health — never reset.
         self.totals: Dict[str, int] = {f: 0 for f in _FAMILIES}
+        # Store fault domain (conversation/resilience.py): a wrapped
+        # store registers this exchange as the "exchange" consumer for
+        # the store_degraded gauge; raw backends no-op.
+        reg = getattr(store, "register_consumer", None)
+        if callable(reg):
+            reg("exchange")
         _register(self)
 
     # -- key scheme -----------------------------------------------------------
@@ -102,6 +108,15 @@ class KVExchange:
         claimer recomputes from ``meta["tokens"]``). Raises on store
         failure — the caller (plane worker) logs and moves on; the
         token stream on the publishing side stays the fallback."""
+        if getattr(self._store, "degraded", False):
+            # Degraded ladder rung (docs/robustness.md): skip the
+            # publish rather than pay for a round-trip known to shed.
+            # The claimer misses and recomputes from history — the
+            # same shape as a publisher that died mid-handoff.
+            log.info("store degraded; skipping exchange publish for %s",
+                     conv_id)
+            self._count("fallback", self.role)
+            return
         m = dict(meta or {})
         m["published_at"] = self._now()
         m["role"] = self.role
@@ -115,11 +130,25 @@ class KVExchange:
         or None (nothing published / expired / torn / store error —
         every miss shape degrades to recompute on the caller)."""
         key = self.key_for(conv_id)
+        t0 = time.perf_counter()
         try:
             blob = self._store.load_kv(key)
-        except Exception:  # noqa: BLE001 — store flake → recompute
+        except Exception:  # noqa: BLE001 — store flake/timeout/degraded
             log.exception("exchange load failed for %s", conv_id)
             self._count("fallback", self.role)
+            return None
+        # Wall budget: a slow-not-dead store (brownout) must not turn
+        # the promote lane into a stall — a claim that spent longer in
+        # the store than the entry's own TTL serves stale KV at best.
+        # The resilience wrapper's op deadline normally fires long
+        # before this; the check is the belt for raw slow backends.
+        elapsed = time.perf_counter() - t0
+        if elapsed > self.claim_ttl_s:
+            self._delete(key)
+            self._count("fallback", self.role)
+            log.warning("exchange claim for %s spent %.1fs in the store "
+                        "(claim_ttl_s=%.1fs); recompute", conv_id,
+                        elapsed, self.claim_ttl_s)
             return None
         if blob is None:
             return None

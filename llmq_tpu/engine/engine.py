@@ -1793,6 +1793,15 @@ class InferenceEngine:
         marks.setdefault(f"{name}_start",
                          marks.pop("_promote_wait", t_claim))
         marks.setdefault(f"{name}_done", time.perf_counter())
+        # Store fault domain attribution (docs/robustness.md): how much
+        # of the promote/claim wait was the conversation store itself
+        # (load / exchange fetch). Underscore key: never an event of
+        # its own — _record_trace attaches it as meta on the span-close
+        # event so the critical-path plane can subtract store waits.
+        store_ms = float(getattr(entry, "store_ms", 0.0) or 0.0)
+        if store_ms > 0.0:
+            marks["_store_wait_ms"] = (
+                marks.get("_store_wait_ms", 0.0) + store_ms)
 
     def _start_sequence(self, seq: _Sequence, slot: int) -> bool:
         """Admit ``seq`` into ``slot``. Returns False only when pages are
@@ -3598,6 +3607,16 @@ class InferenceEngine:
                                 "prefill_done", "first_token",
                                 "decode_done")
                   if stage in marks]
+        store_wait_ms = marks.get("_store_wait_ms", 0.0)
+        if store_wait_ms > 0.0:
+            # Store fault domain (docs/robustness.md): attach the store
+            # round-trip share to the promote/claim span-close event so
+            # the critical-path plane attributes store waits without a
+            # new stage.
+            for i, (stage, ts, ev_meta) in enumerate(events):
+                if stage in ("kv_promote_done", "handoff_claim_done"):
+                    events[i] = (stage, ts, dict(
+                        ev_meta, store_wait_ms=round(store_wait_ms, 3)))
         # Cancellation (client closed the stream / gave up) is its own
         # terminal: neither a success nor a failure the flight recorder
         # should retain.

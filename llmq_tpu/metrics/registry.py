@@ -138,6 +138,18 @@ LABEL_CONTRACT = {
     # llm_queue_replica_ready_seconds.
     "stage": frozenset({"provision", "artifact", "weights", "compile",
                         "warmup", "first_token"}),
+    # Store fault domain (conversation/resilience.py,
+    # docs/robustness.md): which store-backed plane is running its
+    # degraded ladder rung. Closed enum — mirrors resilience.CONSUMERS.
+    "consumer": frozenset({"tiering", "exchange", "state", "placement"}),
+    # store_op_ms / wal_errors_total op label: the store-op surface
+    # plus the WAL journal ops. Closed enum.
+    "op": frozenset({"get", "put", "delete", "list",
+                     "kv_get", "kv_put", "kv_delete", "kv_list",
+                     # WAL journal ops (queueing/wal.py)
+                     "push", "pop", "complete", "fail", "requeue",
+                     "stash", "remove", "fsync"}),
+    "outcome": frozenset({"ok", "error", "timeout", "shed"}),
 }
 
 
@@ -397,6 +409,42 @@ class QueueMetrics:
             f"{ns}_circuit_breaker_trips_total",
             "Breaker transitions into OPEN per endpoint", ["endpoint"],
             registry=registry)
+        # Store fault domain (conversation/resilience.py,
+        # docs/robustness.md "Store fault domain"): every op on the
+        # wrapped conversation store, its bounded-retry count, the
+        # store-scoped breaker, and which consumers are currently on
+        # their degraded ladder rung. Flushed at scrape
+        # (resilience.flush_metrics) — ops only buffer.
+        self.store_op_ms = Histogram(
+            f"{ns}_store_op_ms",
+            "Store operation latency by op and outcome (ok|error|"
+            "timeout|shed; shed = refused fast while degraded)",
+            ["op", "outcome"], buckets=_STEP_MS_BUCKETS,
+            registry=registry)
+        self.store_retries = Counter(
+            f"{ns}_store_retries_total",
+            "Bounded retries of retryable store errors (sqlite locked, "
+            "redis connection resets)", registry=registry)
+        self.store_breaker_state = Gauge(
+            f"{ns}_store_breaker_state",
+            "Store-scoped breaker state (0=closed, 1=half_open, 2=open)",
+            registry=registry)
+        self.store_degraded = Gauge(
+            f"{ns}_store_degraded",
+            "1 while the named consumer is running its degraded ladder "
+            "rung (tiering parks in host, exchange recomputes, state "
+            "serves cache + journals, placement routes role/load-only)",
+            ["consumer"], registry=registry)
+        # WAL fault rung (queueing/wal.py + queue_manager.py): journal
+        # appends/fsyncs that hit an OSError (ENOSPC). Admission-path
+        # failures shed the request with a 503; worker-side ops log
+        # loudly and keep the worker loop alive.
+        self.wal_errors = Counter(
+            f"{ns}_wal_errors_total",
+            "WAL journal operations that failed with an OSError "
+            "(disk full / IO error); push failures shed 503, "
+            "worker-side ops degrade durability but keep serving",
+            ["op"], registry=registry)
         self.engine_restarts = Counter(
             f"{ns}_engine_restarts_total",
             "Engine loop restarts performed by the supervisor",
@@ -710,6 +758,15 @@ def exposition() -> bytes:
         # usage flush so the shared tenant-label bound is warm).
         from llmq_tpu.tenancy import flush_metrics as tenancy_flush
         tenancy_flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # Store fault domain: buffered per-op latency samples, retry
+        # counts, breaker state and the per-consumer degraded gauges
+        # (docs/robustness.md "Store fault domain").
+        from llmq_tpu.conversation.resilience import \
+            flush_metrics as store_flush
+        store_flush()
     except Exception:  # noqa: BLE001
         pass
     return generate_latest(REGISTRY)

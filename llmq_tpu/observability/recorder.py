@@ -112,16 +112,25 @@ class TraceEvent:
 class Timeline:
     """All recorded events of one request, across hosts."""
 
-    __slots__ = ("request_id", "trace_id", "created", "events",
+    __slots__ = ("request_id", "_trace_id", "created", "events",
                  "finalized", "breached")
 
     def __init__(self, request_id: str) -> None:
         self.request_id = request_id
-        self.trace_id = trace_id_for(request_id)
+        # Derived lazily: the md5 is only needed when a timeline is
+        # serialized, and hashing on every stamp is measurable against
+        # the per-request trace budget (test_observability's 3% guard).
+        self._trace_id: Optional[str] = None
         self.created = time.time()
         self.events: List[TraceEvent] = []
         self.finalized = False
         self.breached = False
+
+    @property
+    def trace_id(self) -> str:
+        if self._trace_id is None:
+            self._trace_id = trace_id_for(self.request_id)
+        return self._trace_id
 
     # -- derived views (call with a CONSISTENT snapshot; the recorder
     # -- copies under its lock before handing a timeline out) ---------
@@ -244,8 +253,11 @@ class Timeline:
     def _copy(self) -> "Timeline":
         tl = Timeline(self.request_id)
         tl.created = self.created
-        tl.events = [TraceEvent(e.stage, e.ts, e.host, dict(e.meta))
-                     for e in self.events]
+        # TraceEvents are append-only and never mutated in place once
+        # recorded (to_dict copies meta on the way out), so the frozen
+        # carry shares them — only the LIST is snapshotted, keeping the
+        # terminal-stamp cost inside the per-request trace budget.
+        tl.events = list(self.events)
         tl.finalized = self.finalized
         tl.breached = self.breached
         return tl

@@ -31,7 +31,7 @@ from typing import Callable, ContextManager, Dict, List, Optional
 
 from llmq_tpu.core.clock import Clock, SYSTEM_CLOCK
 from llmq_tpu.core.config import Config, QueueConfig, default_config
-from llmq_tpu.core.errors import QueueEmptyError
+from llmq_tpu.core.errors import QueueEmptyError, WALError
 from llmq_tpu.core.types import Message, Priority, QueueStats, PRIORITY_TIERS
 from llmq_tpu.metrics.registry import get_metrics
 from llmq_tpu.queueing.priority_queue import MultiLevelQueue
@@ -214,17 +214,18 @@ class QueueManager:
         self._apply_rules(message)
         qname = queue_name or self.route_for(message)
         with self._wal_guard():
-            if self._wal:
-                # Journal BEFORE the push: a pop/complete from a
-                # concurrent worker can only happen after the push
-                # succeeds, so records can never appear out of order in
-                # the journal.
-                self._wal.append("push", qname, message.id, message)
+            # Journal BEFORE the push: a pop/complete from a
+            # concurrent worker can only happen after the push
+            # succeeds, so records can never appear out of order in
+            # the journal. critical: a journal that cannot record the
+            # message sheds it (503) instead of accepting work whose
+            # durability promise is already broken.
+            self._wal_append("push", qname, message.id, message,
+                             critical=True)
             try:
                 self.queue.push(qname, message)
             except Exception:
-                if self._wal:
-                    self._wal.append("remove", qname, message.id)
+                self._wal_append("remove", qname, message.id)
                 self._op_metric("push", "error")
                 raise
             if self._wal:
@@ -245,7 +246,7 @@ class QueueManager:
         with self._wal_guard():
             msg = self.queue.pop(queue_name)
             if self._wal:
-                self._wal.append("pop", queue_name, msg.id)
+                self._wal_append("pop", queue_name, msg.id)
                 self._wal_inflight[msg.id] = (queue_name, msg)
         if self._fair is not None:
             # Delivery: charge the tenant's virtual time (estimated
@@ -274,7 +275,7 @@ class QueueManager:
                 if m is None:
                     break
                 if self._wal:
-                    self._wal.append("pop", queue_name, m.id)
+                    self._wal_append("pop", queue_name, m.id)
                     self._wal_inflight[m.id] = (queue_name, m)
             if self._fair is not None:
                 self._fair.note_pop(m)
@@ -306,7 +307,7 @@ class QueueManager:
         with self._wal_guard():
             self.queue.complete_message(qname, message, process_time)
             if self._wal:
-                self._wal.append("complete", qname, message.id)
+                self._wal_append("complete", qname, message.id)
                 self._wal_inflight.pop(message.id, None)
         if self._fair is not None:
             # True-up from measured tokens (metadata.usage) + release
@@ -325,7 +326,7 @@ class QueueManager:
         with self._wal_guard():
             self.queue.fail_message(qname, message, process_time)
             if self._wal:
-                self._wal.append("fail", qname, message.id)
+                self._wal_append("fail", qname, message.id)
                 self._wal_inflight.pop(message.id, None)
         if self._fair is not None:
             self._fair.note_finish(message, ok=False)
@@ -346,7 +347,7 @@ class QueueManager:
         with self._wal_guard():
             self.queue.requeue(qname, message)
             if self._wal:
-                self._wal.append("requeue", qname, message.id)
+                self._wal_append("requeue", qname, message.id)
                 self._wal_inflight.pop(message.id, None)  # back in the queue
         with self._inflight_mu:
             self._inflight[message.id] = qname
@@ -369,7 +370,7 @@ class QueueManager:
         with self._wal_guard():
             self.queue.requeue_accounting_for(qname)
             if self._wal:
-                self._wal.append("stash", qname, message.id)
+                self._wal_append("stash", qname, message.id)
         if self._metrics:
             lbl = (self.name, qname, message.priority.tier_name)
             self._metrics.processing.labels(*lbl).dec()
@@ -386,7 +387,7 @@ class QueueManager:
             with self._wal_guard():
                 msg = self.queue.remove_message(qname, message_id)
                 if msg is not None and self._wal:
-                    self._wal.append("remove", qname, message_id)
+                    self._wal_append("remove", qname, message_id)
                     self._wal_inflight.pop(message_id, None)
             if msg is not None:
                 with self._inflight_mu:
@@ -407,6 +408,39 @@ class QueueManager:
         monitor's compaction sees a consistent live set; free (nullcontext)
         when durability is off."""
         return self._wal_mu if self._wal else contextlib.nullcontext()
+
+    def _wal_append(self, op: str, queue_name: str, message_id: str,
+                    message: Optional[Message] = None, *,
+                    critical: bool = False) -> None:
+        """Journal one op, degrading on disk faults instead of killing
+        the worker loop (docs/robustness.md): an ``OSError`` (ENOSPC,
+        IO error — incl. the chaos plane's ``wal.append`` oserror
+        kind) counts ``wal_errors_total{op}`` and logs loudly.
+        ``critical=True`` (the admission path, BEFORE the queue
+        mutation) re-raises as :class:`WALError` so the REST layer
+        sheds the request with a 503 — nothing is silently accepted
+        without its durability record. Worker-side ops swallow: their
+        queue mutation already happened in memory, so losing the
+        journal record degrades durability (a restart may redeliver —
+        the at-least-once contract the retry path already assumes),
+        never the serving loop."""
+        if not self._wal:
+            return
+        try:
+            self._wal.append(op, queue_name, message_id, message)
+        except OSError as e:
+            log.error(
+                "WAL %s append failed for %s (disk fault? %s) — %s", op,
+                message_id, e,
+                "shedding request with 503" if critical
+                else "continuing WITHOUT a durability record")
+            if self._metrics:
+                try:
+                    self._metrics.wal_errors.labels(op).inc()
+                except Exception:  # noqa: BLE001 — never couple the
+                    pass           # fault path to the metrics plane
+            if critical:
+                raise WALError(op, str(e)) from e
 
     # -- stats / monitor -----------------------------------------------------
 
@@ -462,7 +496,7 @@ class QueueManager:
                         if self._wal:
                             # Expired messages must not resurrect on
                             # restart.
-                            self._wal.append("remove", qname, msg.id)
+                            self._wal_append("remove", qname, msg.id)
                             self._wal_inflight.pop(msg.id, None)
                 if expired:
                     # Keep manager-side accounting consistent: drop the

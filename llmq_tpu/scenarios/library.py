@@ -4,7 +4,7 @@ The shipped scenarios live as YAML specs under ``configs/scenarios/``
 (docs/scenarios.md documents each): ``agentic_tool_loops``,
 ``rag_long_prompt_flood``, ``diurnal_tenant_mix_with_flash_crowd``,
 ``adversarial_id_spray_quota_probe``, ``conversation_soak_100k``,
-``disagg_long_prompt_handoff``.
+``disagg_long_prompt_handoff``, ``store_brownout``.
 :func:`run_scenario` is what the bench section, the CI lane and the
 tests all call — build (or accept) a target, play the schedule on a
 FakeClock, score, optionally emit ``SCENARIO_<name>.json``.
@@ -26,7 +26,8 @@ from llmq_tpu.scenarios.spec import (ScenarioSpec, load_scenario_file,
 SHIPPED = ("agentic_tool_loops", "rag_long_prompt_flood",
            "diurnal_tenant_mix_with_flash_crowd",
            "adversarial_id_spray_quota_probe",
-           "conversation_soak_100k", "disagg_long_prompt_handoff")
+           "conversation_soak_100k", "disagg_long_prompt_handoff",
+           "store_brownout")
 
 
 def scenario_dir(configured: str = "") -> str:
@@ -64,6 +65,67 @@ def load_named(name: str, directory: str = "") -> ScenarioSpec:
         f"(known: {list_scenarios(directory)})")
 
 
+class _StoreTarget(EngineTarget):
+    """EngineTarget whose engine rides a resilience-wrapped store:
+    tiering spill + KV exchange + conversation state all share the ONE
+    wrapped backend, so a ``store.*`` chaos rule browns out every
+    store-backed plane at once (docs/robustness.md "Store fault
+    domain")."""
+
+    def __init__(self, engine: Any, state_manager: Any,
+                 store: Any) -> None:
+        super().__init__(engine, own=True)
+        self.state_manager = state_manager
+        self.store = store
+
+    def stop(self) -> None:
+        super().stop()
+        try:
+            self.store.close()
+        except Exception:  # noqa: BLE001 — teardown must not mask the run
+            pass
+
+
+def _store_target(spec: ScenarioSpec,
+                  rcfg: Any = None) -> _StoreTarget:
+    """Build the store-backed target a ``store.*`` scenario needs: an
+    echo engine with the tiering plane enabled, a state manager, and a
+    KV exchange — all over one ``ResilientKVStore``-wrapped
+    InMemoryStore. Tuned for the compressed clock: sub-second breaker
+    backoff and probe interval so blackout recovery happens inside the
+    run, not minutes of wall time later. ``rcfg`` overrides the
+    resilience config (the bench's no-domain A/B leg passes a
+    neutralized one that keeps the chaos seam but removes every
+    protection)."""
+    from llmq_tpu.conversation.persistence import InMemoryStore
+    from llmq_tpu.conversation.resilience import wrap_store
+    from llmq_tpu.conversation.state_manager import StateManager
+    from llmq_tpu.core.config import (BreakerConfig, ConversationConfig,
+                                      KVTieringConfig,
+                                      StoreResilienceConfig)
+    from llmq_tpu.disagg.exchange import KVExchange
+
+    if rcfg is None:
+        rcfg = StoreResilienceConfig(
+            enabled=True, op_timeout_s=0.3, retries=2,
+            timeout_threshold=3, probe_interval_s=0.05,
+            seed=spec.seed or 1,
+            breaker=BreakerConfig(enabled=True, failure_threshold=3,
+                                  base_backoff=0.2, max_backoff=1.0))
+    store = wrap_store(InMemoryStore(), rcfg)
+    engine = make_echo_engine(
+        f"scn-{spec.name}",
+        kv_tiering=KVTieringConfig(enabled=True, host_capacity_mb=1,
+                                   host_max_conversations=32,
+                                   store_spill=True))
+    sm = StateManager(ConversationConfig(persist=True), store=store)
+    engine.attach_conversation_manager(sm)
+    if engine._tiering is not None:  # noqa: SLF001 — test/tool wiring
+        engine._tiering.exchange = KVExchange(  # noqa: SLF001
+            store, role="unified", metrics=False)
+    return _StoreTarget(engine, sm, store)
+
+
 def run_scenario(scenario: Any, *, target: Any = None,
                  scale: float = 1.0, clock: Optional[Clock] = None,
                  out_dir: str = ".", emit_json: bool = False,
@@ -88,8 +150,13 @@ def run_scenario(scenario: Any, *, target: Any = None,
         clock = FakeClock()
     own_target = target is None
     if own_target:
-        target = EngineTarget(make_echo_engine(f"scn-{spec.name}"),
-                              own=True)
+        if any(str(ev.point).startswith("store.")
+               for ev in spec.chaos_events):
+            # Store-fault scenarios need store-backed planes to fault.
+            target = _store_target(spec)
+        else:
+            target = EngineTarget(make_echo_engine(f"scn-{spec.name}"),
+                                  own=True)
     if reset_planes:
         from llmq_tpu.observability.recorder import get_recorder
         from llmq_tpu.observability.usage import get_usage_ledger

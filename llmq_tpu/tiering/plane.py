@@ -300,7 +300,7 @@ class TierEntry:
     __slots__ = ("conv_id", "tokens", "length", "pending", "n_pages",
                  "tier", "payload", "pooled", "ready", "demoted_at",
                  "last_used", "wait_since", "loading", "source_tier",
-                 "abandoned", "spilling", "from_exchange")
+                 "abandoned", "spilling", "from_exchange", "store_ms")
 
     def __init__(self, conv_id: str, tokens: List[int], length: int,
                  pending: Optional[int], n_pages: int,
@@ -342,6 +342,11 @@ class TierEntry:
         #: hierarchy — the critical-path plane names the admission wait
         #: ``handoff_claim`` instead of ``kv_promote``.
         self.from_exchange = False
+        #: Milliseconds this entry's claim path spent waiting on the
+        #: conversation store (load / exchange fetch), for the
+        #: critical-path plane's store-wait attribution
+        #: (docs/critical_path.md).
+        self.store_ms = 0.0
 
 
 # -- the plane -----------------------------------------------------------------
@@ -393,8 +398,10 @@ class KVTieringPlane:
             getattr(cfg, "eviction_policy", "lru"))
         #: Conversation store with the KV-payload seam (save_kv/
         #: load_kv/delete_kv — persistence.py); feature-detected, so a
-        #: plain store simply disables the spill tier.
-        self.store: Any = None
+        #: plain store simply disables the spill tier. Property: a
+        #: resilience-wrapped store registers this plane as the
+        #: "tiering" consumer for the store_degraded gauge.
+        self._store: Any = None
         #: Cluster-wide KV exchange (disagg plane — duck-typed
         #: ``KVExchange`` with publish/claim, never imported here so
         #: tiering stays standalone). When set, :meth:`prepare` with
@@ -706,9 +713,27 @@ class KVTieringPlane:
             self._store_ids.add(conv_id)
         return True
 
+    @property
+    def store(self) -> Any:
+        return self._store
+
+    @store.setter
+    def store(self, value: Any) -> None:
+        self._store = value
+        reg = getattr(value, "register_consumer", None)
+        if callable(reg):
+            reg("tiering")
+
     def _store_ok(self) -> bool:
+        """Store tier usable right now. A degraded resilient store
+        (breaker OPEN / timeout ladder — conversation/resilience.py)
+        reads as unusable: demotions park in the host tier, spills are
+        skipped and promotes fall back to recompute instead of paying
+        for a round-trip that is known to shed. Raw backends never
+        report degraded, so the check is free when resilience is off."""
         return (self.store is not None
-                and hasattr(self.store, "save_kv"))
+                and hasattr(self.store, "save_kv")
+                and not getattr(self.store, "degraded", False))
 
     def _bound_host_locked_out(self) -> None:
         """Entry-count bound (metadata-only backends have no byte
@@ -818,12 +843,14 @@ class KVTieringPlane:
         payload — never inject foreign page bytes."""
         xchg = self.exchange
         res = None
+        t0 = time.perf_counter()
         if xchg is not None and not entry.abandoned:
             try:
                 res = xchg.claim(entry.conv_id)
             except Exception:  # noqa: BLE001 — claim is best-effort
                 log.exception("kv exchange claim failed for %s",
                               entry.conv_id)
+        entry.store_ms += (time.perf_counter() - t0) * 1e3
         if res is None:
             with self._mu:
                 if self._entries.get(entry.conv_id) is entry:
@@ -992,12 +1019,16 @@ class KVTieringPlane:
     def _load(self, entry: TierEntry) -> None:
         """Worker: store blob → host payload (published atomically)."""
         blob = None
+        t0 = time.perf_counter()
         try:
             blob = self.store.load_kv(entry.conv_id)
         except Exception:  # noqa: BLE001
             log.exception("kv store load failed for %s", entry.conv_id)
             with self._mu:
                 self.store_errors += 1
+        # Critical-path attribution: how long this promote waited on
+        # the store, success or not (docs/critical_path.md).
+        entry.store_ms += (time.perf_counter() - t0) * 1e3
         if blob is not None and not entry.abandoned:
             try:
                 bufs, _specs = decode_blob(blob)
